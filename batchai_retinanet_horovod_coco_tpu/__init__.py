@@ -1,0 +1,23 @@
+"""TPU-native RetinaNet training framework.
+
+A ground-up JAX/XLA rebuild of the capability surface of
+``msalvaris/batchai_retinanet_horovod_coco`` (RetinaNet ResNet-50-FPN on COCO,
+Horovod data-parallel on Azure Batch AI), re-designed TPU-first:
+
+- the Keras graph + Horovod ``DistributedOptimizer`` allreduce become ONE
+  jit-compiled SPMD train step with ``jax.lax.psum`` over a device mesh
+  (see ``parallel/`` and ``train/step.py``);
+- host-side Cython anchor/IoU machinery (reference: keras-retinanet
+  ``utils/compute_overlap.pyx``, ``utils/anchors.py``) becomes jit'd
+  device-side ops (``ops/``);
+- the CPU/GPU ``FilterDetections`` NMS layer becomes an on-device batched
+  fixed-shape NMS (``ops/nms.py``, ``evaluate/detect.py``);
+- pycocotools' C COCOeval becomes a self-contained numpy oracle with an
+  optional C++ fast path (``evaluate/coco_eval.py``, ``native/``).
+
+Reference structure is documented in /root/repo/SURVEY.md (the reference mount
+was unavailable; citations therein are capability-level, anchored on
+BASELINE.json).
+"""
+
+__version__ = "0.1.0"

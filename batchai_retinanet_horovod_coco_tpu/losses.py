@@ -1,0 +1,115 @@
+"""Detection losses: focal classification loss + smooth-L1 box regression.
+
+Capability parity with keras-retinanet ``losses.py`` (SURVEY.md M4):
+- focal loss with alpha=0.25, gamma=2.0, computed on sigmoid logits over all
+  non-ignored anchors, normalized by the per-image positive-anchor count
+  (min 1) and averaged over the batch;
+- smooth-L1 with sigma=3 (beta = 1/sigma^2) on positive anchors only, with the
+  same per-image normalization.
+
+TPU-first differences from the reference:
+- Losses consume the dense fixed-shape targets produced on device by
+  ``ops.matching.anchor_targets`` (the reference computed targets on the host
+  loader thread and shipped them with the batch).
+- Everything is expressed on logits (numerically stable
+  log-sigmoid formulation), in the computation dtype of the model (bf16-safe:
+  reductions accumulate in f32).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+from jax import nn
+
+from batchai_retinanet_horovod_coco_tpu.ops import matching
+
+
+@dataclasses.dataclass(frozen=True)
+class LossConfig:
+    focal_alpha: float = 0.25
+    focal_gamma: float = 2.0
+    smooth_l1_beta: float = 1.0 / 9.0  # sigma=3 in the reference parametrization
+    box_loss_weight: float = 1.0
+
+
+def focal_loss(
+    cls_logits: jnp.ndarray,
+    cls_targets: jnp.ndarray,
+    anchor_state: jnp.ndarray,
+    config: LossConfig = LossConfig(),
+) -> jnp.ndarray:
+    """Scalar focal loss.
+
+    Args:
+      cls_logits: (..., A, K) raw logits.
+      cls_targets: (..., A, K) one-hot targets (all-zero rows for negatives).
+      anchor_state: (..., A) in {-1 ignore, 0 negative, 1 positive}.
+    """
+    logits = cls_logits.astype(jnp.float32)
+    targets = cls_targets.astype(jnp.float32)
+
+    p = nn.sigmoid(logits)
+    # Stable BCE from logits.
+    bce = nn.softplus(logits) - logits * targets  # == -[t log p + (1-t) log(1-p)]
+    p_t = p * targets + (1.0 - p) * (1.0 - targets)
+    alpha_t = config.focal_alpha * targets + (1.0 - config.focal_alpha) * (
+        1.0 - targets
+    )
+    loss = alpha_t * (1.0 - p_t) ** config.focal_gamma * bce  # (..., A, K)
+
+    not_ignored = (anchor_state != matching.IGNORE).astype(jnp.float32)
+    loss = loss * not_ignored[..., None]
+
+    # Reference parity: normalize by the PER-IMAGE positive count (min 1), then
+    # average over the batch, so crowded images don't dominate the gradient.
+    per_image = jnp.sum(loss, axis=(-2, -1))
+    num_pos = jnp.sum(
+        (anchor_state == matching.POSITIVE).astype(jnp.float32), axis=-1
+    )
+    return jnp.mean(per_image / jnp.maximum(num_pos, 1.0))
+
+
+def smooth_l1_loss(
+    box_preds: jnp.ndarray,
+    box_targets: jnp.ndarray,
+    anchor_state: jnp.ndarray,
+    config: LossConfig = LossConfig(),
+) -> jnp.ndarray:
+    """Scalar smooth-L1 regression loss over positive anchors.
+
+    Args:
+      box_preds: (..., A, 4) predicted deltas.
+      box_targets: (..., A, 4) encoded target deltas.
+      anchor_state: (..., A).
+    """
+    preds = box_preds.astype(jnp.float32)
+    targets = box_targets.astype(jnp.float32)
+    diff = jnp.abs(preds - targets)
+    beta = config.smooth_l1_beta
+    loss = jnp.where(diff < beta, 0.5 * diff * diff / beta, diff - 0.5 * beta)
+
+    positive = (anchor_state == matching.POSITIVE).astype(jnp.float32)
+    loss = loss * positive[..., None]
+    # Per-image normalization, then batch mean (see focal_loss).
+    per_image = jnp.sum(loss, axis=(-2, -1))
+    num_pos = jnp.sum(positive, axis=-1)
+    return jnp.mean(per_image / jnp.maximum(num_pos, 1.0))
+
+
+def total_loss(
+    cls_logits: jnp.ndarray,
+    box_preds: jnp.ndarray,
+    cls_targets: jnp.ndarray,
+    box_targets: jnp.ndarray,
+    anchor_state: jnp.ndarray,
+    config: LossConfig = LossConfig(),
+) -> dict[str, jnp.ndarray]:
+    cls = focal_loss(cls_logits, cls_targets, anchor_state, config)
+    box = smooth_l1_loss(box_preds, box_targets, anchor_state, config)
+    return {
+        "loss": cls + config.box_loss_weight * box,
+        "cls_loss": cls,
+        "box_loss": box,
+    }

@@ -1,0 +1,46 @@
+"""Pure, jit-able detection ops: anchors, IoU, box codec, target matching, NMS.
+
+These replace the reference stack's host-side anchor machinery
+(keras-retinanet ``utils/anchors.py``, SURVEY.md M5) and its Cython IoU kernel
+(``utils/compute_overlap.pyx``, SURVEY.md M7) with device-side XLA ops, per the
+north-star requirement that anchor generation and IoU-based target assignment
+run as jit'd device-side ops (BASELINE.json:5).
+"""
+
+from batchai_retinanet_horovod_coco_tpu.ops.anchors import (
+    AnchorConfig,
+    anchors_for_image_shape,
+    generate_base_anchors,
+)
+from batchai_retinanet_horovod_coco_tpu.ops.boxes import (
+    BoxCodecConfig,
+    clip_boxes,
+    decode_boxes,
+    encode_boxes,
+)
+from batchai_retinanet_horovod_coco_tpu.ops.iou import pairwise_iou
+from batchai_retinanet_horovod_coco_tpu.ops.matching import (
+    MatchingConfig,
+    anchor_targets,
+    assign_anchors,
+)
+from batchai_retinanet_horovod_coco_tpu.ops.nms import (
+    multiclass_nms,
+    single_class_nms,
+)
+
+__all__ = [
+    "AnchorConfig",
+    "BoxCodecConfig",
+    "MatchingConfig",
+    "anchor_targets",
+    "anchors_for_image_shape",
+    "assign_anchors",
+    "clip_boxes",
+    "decode_boxes",
+    "encode_boxes",
+    "generate_base_anchors",
+    "multiclass_nms",
+    "pairwise_iou",
+    "single_class_nms",
+]

@@ -1,0 +1,91 @@
+"""Box encode/decode between corner boxes and regression deltas, plus clipping.
+
+Replaces keras-retinanet's ``bbox_transform`` / ``RegressBoxes`` / ``ClipBoxes``
+(SURVEY.md M5).  We use the standard center-form parametrization
+(dx, dy, dw, dh) with normalization stds — a deliberate redesign (the reference
+used corner-form deltas); the two are equivalent in expressive power and the
+center form is the widely validated detectron recipe.
+
+All functions are pure jnp and shape-preserving, safe under jit/vmap.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class BoxCodecConfig:
+    means: tuple[float, float, float, float] = (0.0, 0.0, 0.0, 0.0)
+    stds: tuple[float, float, float, float] = (0.1, 0.1, 0.2, 0.2)
+    # Clamp on dw/dh before exp, to keep decode finite for garbage logits.
+    max_log_scale: float = 4.135  # log(1000/16), detectron convention
+
+
+def _to_center_form(boxes: jnp.ndarray) -> tuple[jnp.ndarray, ...]:
+    w = boxes[..., 2] - boxes[..., 0]
+    h = boxes[..., 3] - boxes[..., 1]
+    cx = boxes[..., 0] + 0.5 * w
+    cy = boxes[..., 1] + 0.5 * h
+    return cx, cy, w, h
+
+
+def encode_boxes(
+    anchors: jnp.ndarray,
+    gt_boxes: jnp.ndarray,
+    config: BoxCodecConfig = BoxCodecConfig(),
+) -> jnp.ndarray:
+    """Regression targets for ``gt_boxes`` w.r.t. ``anchors``; both (..., 4)."""
+    acx, acy, aw, ah = _to_center_form(anchors)
+    gcx, gcy, gw, gh = _to_center_form(gt_boxes)
+    # Guard against degenerate (padded) boxes; callers mask these out.
+    aw = jnp.maximum(aw, 1e-6)
+    ah = jnp.maximum(ah, 1e-6)
+    gw = jnp.maximum(gw, 1e-6)
+    gh = jnp.maximum(gh, 1e-6)
+    deltas = jnp.stack(
+        [
+            (gcx - acx) / aw,
+            (gcy - acy) / ah,
+            jnp.log(gw / aw),
+            jnp.log(gh / ah),
+        ],
+        axis=-1,
+    )
+    means = jnp.asarray(config.means, dtype=deltas.dtype)
+    stds = jnp.asarray(config.stds, dtype=deltas.dtype)
+    return (deltas - means) / stds
+
+
+def decode_boxes(
+    anchors: jnp.ndarray,
+    deltas: jnp.ndarray,
+    config: BoxCodecConfig = BoxCodecConfig(),
+) -> jnp.ndarray:
+    """Inverse of :func:`encode_boxes`: (..., 4) deltas → corner boxes."""
+    means = jnp.asarray(config.means, dtype=deltas.dtype)
+    stds = jnp.asarray(config.stds, dtype=deltas.dtype)
+    deltas = deltas * stds + means
+    acx, acy, aw, ah = _to_center_form(anchors)
+    dx, dy, dw, dh = (deltas[..., i] for i in range(4))
+    dw = jnp.clip(dw, max=config.max_log_scale)
+    dh = jnp.clip(dh, max=config.max_log_scale)
+    cx = acx + dx * aw
+    cy = acy + dy * ah
+    w = aw * jnp.exp(dw)
+    h = ah * jnp.exp(dh)
+    return jnp.stack(
+        [cx - 0.5 * w, cy - 0.5 * h, cx + 0.5 * w, cy + 0.5 * h], axis=-1
+    )
+
+
+def clip_boxes(boxes: jnp.ndarray, image_hw: tuple[int, int]) -> jnp.ndarray:
+    """Clip corner boxes to [0, W] x [0, H]."""
+    h, w = image_hw
+    x1 = jnp.clip(boxes[..., 0], 0.0, float(w))
+    y1 = jnp.clip(boxes[..., 1], 0.0, float(h))
+    x2 = jnp.clip(boxes[..., 2], 0.0, float(w))
+    y2 = jnp.clip(boxes[..., 3], 0.0, float(h))
+    return jnp.stack([x1, y1, x2, y2], axis=-1)

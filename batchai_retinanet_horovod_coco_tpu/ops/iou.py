@@ -1,0 +1,39 @@
+"""Pairwise IoU as a device-side XLA op.
+
+Replaces the reference's Cython ``compute_overlap(boxes, query)`` host kernel
+(SURVEY.md M7, ``utils/compute_overlap.pyx``) — the hot inner op of target
+assignment that the reference runs per-image on the data-loader CPU thread.
+Here it is a broadcasted jnp expression: XLA fuses the whole (A, G) IoU matrix
+computation with the downstream argmax of target assignment into a handful of
+kernels, and it vmaps cleanly over the batch dimension.
+
+For the training-time shapes (A ≈ 1e5 anchors x G ≤ 100 padded gt boxes,
+f32 → ~40 MB per image before fusion) this is elementwise/VPU work that XLA
+handles well; a Pallas kernel is not warranted unless profiling shows the
+materialized (A, G) intermediate becoming HBM-bound (SURVEY.md §2.5).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def box_area(boxes: jnp.ndarray) -> jnp.ndarray:
+    """Area of (..., 4) corner boxes; degenerate boxes have area 0."""
+    w = jnp.maximum(boxes[..., 2] - boxes[..., 0], 0.0)
+    h = jnp.maximum(boxes[..., 3] - boxes[..., 1], 0.0)
+    return w * h
+
+
+def pairwise_iou(boxes_a: jnp.ndarray, boxes_b: jnp.ndarray) -> jnp.ndarray:
+    """IoU matrix between (N, 4) and (M, 4) corner boxes → (N, M) in [0, 1].
+
+    Degenerate boxes (zero/negative extent, e.g. padding) yield IoU 0 against
+    everything, so callers may rely on padded gt rows never matching.
+    """
+    lt = jnp.maximum(boxes_a[:, None, :2], boxes_b[None, :, :2])  # (N, M, 2)
+    rb = jnp.minimum(boxes_a[:, None, 2:], boxes_b[None, :, 2:])  # (N, M, 2)
+    wh = jnp.maximum(rb - lt, 0.0)
+    intersection = wh[..., 0] * wh[..., 1]
+    union = box_area(boxes_a)[:, None] + box_area(boxes_b)[None, :] - intersection
+    return jnp.where(union > 0.0, intersection / jnp.maximum(union, 1e-12), 0.0)

@@ -1,0 +1,139 @@
+"""IoU-based anchor→gt target assignment as a jit'd device op.
+
+Capability parity with keras-retinanet's ``anchor_targets_bbox`` /
+``compute_gt_annotations`` (SURVEY.md M5): per-anchor argmax-IoU assignment
+with IoU ≥ 0.5 positive, < 0.4 negative, in-between ignored — but executed on
+device, vmapped over the batch, instead of per-image on the host loader thread
+(SURVEY.md call stack 3.3).
+
+Design notes (TPU-first):
+- GT boxes arrive padded to a fixed ``max_gt`` with a validity mask, keeping
+  every shape static.  Padded rows are degenerate boxes → IoU 0 → can never
+  become positives; we additionally mask them explicitly for robustness.
+- In addition to the per-anchor rule we force-assign, for every valid gt, the
+  anchor with the highest IoU (the RetinaNet paper's low-quality-match rescue;
+  without it small objects can end up with zero positive anchors).
+- Outputs are dense fixed-shape tensors consumed directly by the losses:
+  one-hot class targets, box-delta targets, and a per-anchor state in
+  {-1 ignore, 0 negative, 1 positive}.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from batchai_retinanet_horovod_coco_tpu.ops.boxes import BoxCodecConfig, encode_boxes
+from batchai_retinanet_horovod_coco_tpu.ops.iou import pairwise_iou
+
+IGNORE = -1
+NEGATIVE = 0
+POSITIVE = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class MatchingConfig:
+    positive_iou: float = 0.5
+    negative_iou: float = 0.4
+    # Force-match each gt's best anchor even below positive_iou.
+    force_match_best: bool = True
+
+
+class AnchorAssignment(NamedTuple):
+    matched_gt: jnp.ndarray  # (A,) int32 index into gt rows (0 if unmatched)
+    state: jnp.ndarray  # (A,) int32 in {IGNORE, NEGATIVE, POSITIVE}
+
+
+class AnchorTargets(NamedTuple):
+    cls_targets: jnp.ndarray  # (A, num_classes) one-hot float
+    box_targets: jnp.ndarray  # (A, 4) encoded deltas (valid where positive)
+    state: jnp.ndarray  # (A,) int32
+
+
+def assign_anchors(
+    anchors: jnp.ndarray,
+    gt_boxes: jnp.ndarray,
+    gt_mask: jnp.ndarray,
+    config: MatchingConfig = MatchingConfig(),
+) -> AnchorAssignment:
+    """Assign each of A anchors to one of G (padded) gt boxes.
+
+    Args:
+      anchors: (A, 4) corner boxes.
+      gt_boxes: (G, 4) corner boxes, padded rows arbitrary.
+      gt_mask: (G,) bool, True for real gt rows.
+    """
+    iou = pairwise_iou(anchors, gt_boxes)  # (A, G)
+    iou = jnp.where(gt_mask[None, :], iou, 0.0)
+
+    matched_gt = jnp.argmax(iou, axis=1).astype(jnp.int32)  # (A,)
+    max_iou = jnp.max(iou, axis=1)  # (A,)
+
+    any_gt = jnp.any(gt_mask)
+    positive = (max_iou >= config.positive_iou) & any_gt
+    negative = max_iou < config.negative_iou
+
+    if config.force_match_best:
+        # For each valid gt, its argmax anchor becomes positive for that gt.
+        best_anchor = jnp.argmax(iou, axis=0)  # (G,)
+        # Guard: only gts with some overlap (> 0) get a forced anchor.
+        has_overlap = jnp.max(iou, axis=0) > 0.0
+        force = gt_mask & has_overlap
+        # Scatter gt g onto anchor best_anchor[g].  Non-forced gts (padding /
+        # no overlap) are routed to out-of-range index A so mode="drop"
+        # discards them — they must not clobber real writes at anchor 0
+        # (argmax of an all-zero IoU column is 0).
+        num_anchors = anchors.shape[0]
+        target = jnp.where(force, best_anchor, num_anchors)
+        forced_flag = jnp.zeros(num_anchors, dtype=bool).at[target].set(
+            True, mode="drop"
+        )
+        forced_idx = (
+            jnp.zeros(num_anchors, dtype=jnp.int32)
+            .at[target]
+            .set(jnp.arange(gt_boxes.shape[0], dtype=jnp.int32), mode="drop")
+        )
+        matched_gt = jnp.where(forced_flag, forced_idx, matched_gt)
+        positive = positive | forced_flag
+        negative = negative & ~forced_flag
+
+    state = jnp.full(anchors.shape[0], IGNORE, dtype=jnp.int32)
+    state = jnp.where(negative, NEGATIVE, state)
+    state = jnp.where(positive, POSITIVE, state)
+    return AnchorAssignment(matched_gt=matched_gt, state=state)
+
+
+def anchor_targets(
+    anchors: jnp.ndarray,
+    gt_boxes: jnp.ndarray,
+    gt_labels: jnp.ndarray,
+    gt_mask: jnp.ndarray,
+    num_classes: int,
+    matching: MatchingConfig = MatchingConfig(),
+    codec: BoxCodecConfig = BoxCodecConfig(),
+) -> AnchorTargets:
+    """Dense per-anchor classification + regression targets for one image.
+
+    vmap over a leading batch axis for batched use (anchors held constant):
+    ``jax.vmap(anchor_targets, in_axes=(None, 0, 0, 0, None))``.
+    """
+    assignment = assign_anchors(anchors, gt_boxes, gt_mask, matching)
+    matched_boxes = gt_boxes[assignment.matched_gt]  # (A, 4)
+    matched_labels = gt_labels[assignment.matched_gt]  # (A,)
+
+    positive = assignment.state == POSITIVE
+    cls_targets = (
+        jnp.zeros((anchors.shape[0], num_classes), dtype=jnp.float32)
+        .at[jnp.arange(anchors.shape[0]), jnp.clip(matched_labels, 0, num_classes - 1)]
+        .set(1.0)
+    )
+    cls_targets = jnp.where(positive[:, None], cls_targets, 0.0)
+    box_targets = encode_boxes(anchors, matched_boxes, codec)
+    box_targets = jnp.where(positive[:, None], box_targets, 0.0)
+    return AnchorTargets(
+        cls_targets=cls_targets,
+        box_targets=box_targets,
+        state=assignment.state,
+    )

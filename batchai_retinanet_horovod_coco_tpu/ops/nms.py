@@ -1,0 +1,127 @@
+"""Fixed-shape batched NMS for TPU.
+
+Replaces keras-retinanet's ``FilterDetections`` layer (SURVEY.md M6), which
+relies on TF's dynamic-shape ``non_max_suppression`` on CPU/GPU.  TPU/XLA
+requires static shapes, so the pipeline here is (BASELINE.json:11,
+"on-device batched NMS"):
+
+  1. score threshold → invalid entries get score -inf (shape preserved);
+  2. top-K pre-selection (``lax.top_k``) to a fixed ``pre_nms_size``;
+  3. greedy suppression as a K-step ``fori_loop`` over a precomputed (K, K)
+     IoU matrix — O(K^2) memory with K ≤ ~1000, a few MB, fused by XLA;
+  4. fixed ``max_detections`` output with a validity mask.
+
+Multi-class NMS uses the class-offset trick: boxes are translated by
+``class_id * offset`` so cross-class pairs can never overlap, letting one
+single-class pass handle all classes at once (same result as per-class NMS).
+
+Everything vmaps over a leading batch axis.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from batchai_retinanet_horovod_coco_tpu.ops.iou import pairwise_iou
+
+_NEG_INF = -1e9
+
+
+class Detections(NamedTuple):
+    boxes: jnp.ndarray  # (max_detections, 4)
+    scores: jnp.ndarray  # (max_detections,)
+    labels: jnp.ndarray  # (max_detections,) int32
+    valid: jnp.ndarray  # (max_detections,) bool
+
+
+def single_class_nms(
+    boxes: jnp.ndarray,
+    scores: jnp.ndarray,
+    iou_threshold: float = 0.5,
+    max_output: int = 100,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Greedy NMS over (N, 4) boxes / (N,) scores.
+
+    Returns ``(indices, valid)`` of shape (max_output,): indices into the input
+    ordered by descending score, with ``valid`` False for suppressed/padded
+    slots.  Entries with score ≤ _NEG_INF/2 are treated as padding.
+    """
+    n = boxes.shape[0]
+    order_scores, order = lax.top_k(scores, n)  # full sort by score
+    sorted_boxes = boxes[order]
+
+    iou = pairwise_iou(sorted_boxes, sorted_boxes)  # (N, N)
+
+    def body(i, keep):
+        # Anchor i survives iff not suppressed by an earlier kept box.
+        # Suppress all later boxes overlapping a *kept* box i.
+        suppress = (iou[i] > iou_threshold) & keep[i]
+        suppress = suppress.at[i].set(False)
+        # Only suppress boxes ranked after i (greedy order).
+        later = jnp.arange(n) > i
+        return keep & ~(suppress & later)
+
+    keep = jnp.ones(n, dtype=bool)
+    keep &= order_scores > _NEG_INF / 2  # drop padding
+    keep = lax.fori_loop(0, n, body, keep)
+
+    # Compact kept indices to the front, preserving score order.  If fewer
+    # candidates than max_output exist, pad with invalid slots.
+    kept_scores = jnp.where(keep, order_scores, _NEG_INF)
+    k = min(max_output, n)
+    _, sel = lax.top_k(kept_scores, k)
+    valid = kept_scores[sel] > _NEG_INF / 2
+    if k < max_output:
+        pad = max_output - k
+        sel = jnp.concatenate([sel, jnp.zeros(pad, dtype=sel.dtype)])
+        valid = jnp.concatenate([valid, jnp.zeros(pad, dtype=bool)])
+    return order[sel], valid
+
+
+def multiclass_nms(
+    boxes: jnp.ndarray,
+    cls_scores: jnp.ndarray,
+    score_threshold: float = 0.05,
+    iou_threshold: float = 0.5,
+    pre_nms_size: int = 1000,
+    max_detections: int = 300,
+    class_offset: float = 1e4,
+) -> Detections:
+    """All-class NMS over (A, 4) boxes and (A, K) per-class scores.
+
+    Mirrors the reference FilterDetections semantics (score 0.05 → per-class
+    NMS 0.5 → top-300, SURVEY.md M6) with fixed shapes.  Each (anchor, class)
+    pair is one candidate, as in keras-retinanet's non-class-specific path.
+    """
+    num_anchors, num_classes = cls_scores.shape
+    flat_scores = cls_scores.reshape(-1)  # (A*K,) anchor-major
+    flat_scores = jnp.where(flat_scores > score_threshold, flat_scores, _NEG_INF)
+
+    k = min(pre_nms_size, flat_scores.shape[0])
+    top_scores, top_idx = lax.top_k(flat_scores, k)
+    anchor_idx = top_idx // num_classes
+    class_idx = (top_idx % num_classes).astype(jnp.int32)
+
+    cand_boxes = boxes[anchor_idx]  # (k, 4)
+    offset_boxes = cand_boxes + (class_idx.astype(cand_boxes.dtype) * class_offset)[
+        :, None
+    ]
+
+    sel, valid = single_class_nms(
+        offset_boxes, top_scores, iou_threshold=iou_threshold, max_output=max_detections
+    )
+    return Detections(
+        boxes=jnp.where(valid[:, None], cand_boxes[sel], 0.0),
+        scores=jnp.where(valid, top_scores[sel], _NEG_INF),
+        labels=jnp.where(valid, class_idx[sel], -1),
+        valid=valid,
+    )
+
+
+batched_multiclass_nms = jax.vmap(
+    multiclass_nms, in_axes=(0, 0), out_axes=0
+)

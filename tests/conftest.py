@@ -1,0 +1,23 @@
+"""Test env: force an 8-virtual-device CPU mesh regardless of TPU presence.
+
+This gives every test the real SPMD code path (shard_map/psum over an 8-device
+mesh) without TPU hardware, per SURVEY.md §4.3.
+
+Note: this container's sitecustomize registers an 'axon' TPU PJRT backend at
+interpreter start and prepends it to jax_platforms, so setting the
+JAX_PLATFORMS env var here is NOT sufficient — we must override the config
+after importing jax (backend selection is lazy, so this is still early
+enough).
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

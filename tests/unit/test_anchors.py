@@ -1,0 +1,65 @@
+import math
+
+import numpy as np
+
+from batchai_retinanet_horovod_coco_tpu.ops.anchors import (
+    AnchorConfig,
+    anchors_for_image_shape,
+    generate_base_anchors,
+)
+
+
+def test_base_anchor_count_and_areas():
+    cfg = AnchorConfig()
+    base = generate_base_anchors(32, cfg.ratios, cfg.scales)
+    assert base.shape == (9, 4)
+    # Every anchor is centered at the origin.
+    centers = (base[:, :2] + base[:, 2:]) / 2.0
+    np.testing.assert_allclose(centers, 0.0, atol=1e-4)
+    # Areas: (size*scale)^2 for each scale, repeated per ratio.
+    areas = (base[:, 2] - base[:, 0]) * (base[:, 3] - base[:, 1])
+    expected = np.array([(32 * s) ** 2 for s in cfg.scales] * 3)
+    np.testing.assert_allclose(areas, expected, rtol=1e-5)
+
+
+def test_base_anchor_aspect_ratios():
+    cfg = AnchorConfig()
+    base = generate_base_anchors(64, cfg.ratios, cfg.scales)
+    w = base[:, 2] - base[:, 0]
+    h = base[:, 3] - base[:, 1]
+    ratios = h / w
+    expected = np.repeat(np.array(cfg.ratios), len(cfg.scales))
+    np.testing.assert_allclose(ratios, expected, rtol=1e-5)
+
+
+def test_anchor_grid_hand_computed():
+    """2x2 P3 grid on a 16x16 image: shift centers at stride*(i+0.5)."""
+    cfg = AnchorConfig(levels=(3,), strides=(8,), sizes=(32,), ratios=(1.0,), scales=(1.0,))
+    anchors = anchors_for_image_shape((16, 16), cfg)
+    assert anchors.shape == (4, 4)
+    centers = (anchors[:, :2] + anchors[:, 2:]) / 2.0
+    expected_centers = np.array(
+        [[4.0, 4.0], [12.0, 4.0], [4.0, 12.0], [12.0, 12.0]]
+    )
+    np.testing.assert_allclose(centers, expected_centers, atol=1e-4)
+    # All boxes are 32x32.
+    np.testing.assert_allclose(anchors[:, 2] - anchors[:, 0], 32.0)
+
+
+def test_total_anchor_count_800_1333():
+    cfg = AnchorConfig()
+    anchors = anchors_for_image_shape((800, 1344), cfg)
+    expected = 0
+    for stride in cfg.strides:
+        fh = math.ceil(800 / stride)
+        fw = math.ceil(1344 / stride)
+        expected += fh * fw * 9
+    assert anchors.shape == (expected, 4)
+    # ~200k anchors for the flagship bucket, plausibility per SURVEY.md 3.3.
+    assert 90_000 < expected < 250_000
+
+
+def test_anchor_cache_identity():
+    a = anchors_for_image_shape((256, 256))
+    b = anchors_for_image_shape((256, 256))
+    assert a is b  # lru_cache returns the same array: free at step time

@@ -1,0 +1,53 @@
+import numpy as np
+
+from batchai_retinanet_horovod_coco_tpu.ops.boxes import (
+    BoxCodecConfig,
+    clip_boxes,
+    decode_boxes,
+    encode_boxes,
+)
+
+
+def random_boxes(rng, n, lo=0, hi=200):
+    xy = rng.uniform(lo, hi, size=(n, 2))
+    wh = rng.uniform(2, 80, size=(n, 2))
+    return np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+
+
+def test_encode_decode_roundtrip():
+    rng = np.random.default_rng(1)
+    anchors = random_boxes(rng, 64)
+    gt = random_boxes(rng, 64)
+    deltas = encode_boxes(anchors, gt)
+    recon = np.asarray(decode_boxes(anchors, deltas))
+    np.testing.assert_allclose(recon, gt, atol=1e-3)
+
+
+def test_encode_identity_is_mean():
+    cfg = BoxCodecConfig()
+    anchors = np.array([[10, 10, 50, 50]], dtype=np.float32)
+    deltas = np.asarray(encode_boxes(anchors, anchors, cfg))
+    np.testing.assert_allclose(deltas, 0.0, atol=1e-6)
+
+
+def test_encode_known_values():
+    cfg = BoxCodecConfig(stds=(1.0, 1.0, 1.0, 1.0))
+    anchors = np.array([[0, 0, 10, 10]], dtype=np.float32)  # cx=cy=5, w=h=10
+    gt = np.array([[5, 5, 25, 25]], dtype=np.float32)  # cx=cy=15, w=h=20
+    deltas = np.asarray(encode_boxes(anchors, gt, cfg))[0]
+    np.testing.assert_allclose(deltas, [1.0, 1.0, np.log(2.0), np.log(2.0)], atol=1e-5)
+
+
+def test_decode_clamps_extreme_scales():
+    anchors = np.array([[0, 0, 10, 10]], dtype=np.float32)
+    deltas = np.array([[0, 0, 100.0, 100.0]], dtype=np.float32)
+    boxes = np.asarray(decode_boxes(anchors, deltas))
+    assert np.all(np.isfinite(boxes))
+
+
+def test_clip_boxes():
+    boxes = np.array([[-5, -5, 20, 20], [90, 90, 200, 300]], dtype=np.float32)
+    clipped = np.asarray(clip_boxes(boxes, (100, 150)))
+    np.testing.assert_allclose(
+        clipped, [[0, 0, 20, 20], [90, 90, 150, 100]], atol=1e-6
+    )

@@ -1,0 +1,50 @@
+import numpy as np
+
+from batchai_retinanet_horovod_coco_tpu.ops.iou import pairwise_iou
+
+
+def brute_force_iou(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    out = np.zeros((len(a), len(b)), dtype=np.float64)
+    for i, bi in enumerate(a):
+        for j, bj in enumerate(b):
+            ix1 = max(bi[0], bj[0])
+            iy1 = max(bi[1], bj[1])
+            ix2 = min(bi[2], bj[2])
+            iy2 = min(bi[3], bj[3])
+            iw = max(ix2 - ix1, 0.0)
+            ih = max(iy2 - iy1, 0.0)
+            inter = iw * ih
+            area_i = max(bi[2] - bi[0], 0) * max(bi[3] - bi[1], 0)
+            area_j = max(bj[2] - bj[0], 0) * max(bj[3] - bj[1], 0)
+            union = area_i + area_j - inter
+            out[i, j] = inter / union if union > 0 else 0.0
+    return out
+
+
+def test_iou_matches_brute_force():
+    rng = np.random.default_rng(0)
+    xy = rng.uniform(0, 100, size=(40, 2))
+    wh = rng.uniform(1, 50, size=(40, 2))
+    a = np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+    xy = rng.uniform(0, 100, size=(17, 2))
+    wh = rng.uniform(1, 50, size=(17, 2))
+    b = np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+    got = np.asarray(pairwise_iou(a, b))
+    np.testing.assert_allclose(got, brute_force_iou(a, b), atol=1e-5)
+
+
+def test_iou_exact_values():
+    a = np.array([[0, 0, 10, 10]], dtype=np.float32)
+    b = np.array(
+        [[0, 0, 10, 10], [5, 5, 15, 15], [10, 10, 20, 20], [20, 20, 30, 30]],
+        dtype=np.float32,
+    )
+    got = np.asarray(pairwise_iou(a, b))[0]
+    np.testing.assert_allclose(got, [1.0, 25.0 / 175.0, 0.0, 0.0], atol=1e-6)
+
+
+def test_degenerate_boxes_zero_iou():
+    a = np.array([[5, 5, 5, 5], [3, 3, 2, 2]], dtype=np.float32)  # degenerate
+    b = np.array([[0, 0, 10, 10]], dtype=np.float32)
+    got = np.asarray(pairwise_iou(a, b))
+    np.testing.assert_allclose(got, 0.0)

@@ -1,0 +1,122 @@
+import numpy as np
+
+from batchai_retinanet_horovod_coco_tpu.losses import (
+    LossConfig,
+    focal_loss,
+    smooth_l1_loss,
+    total_loss,
+)
+
+
+def sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def numpy_focal(logits, targets, state, alpha=0.25, gamma=2.0):
+    p = sigmoid(logits)
+    bce = -(targets * np.log(p) + (1 - targets) * np.log(1 - p))
+    p_t = p * targets + (1 - p) * (1 - targets)
+    a_t = alpha * targets + (1 - alpha) * (1 - targets)
+    loss = a_t * (1 - p_t) ** gamma * bce
+    loss = loss * (state != -1)[:, None]
+    return loss.sum() / max((state == 1).sum(), 1)
+
+
+def test_focal_matches_closed_form():
+    rng = np.random.default_rng(3)
+    logits = rng.normal(size=(20, 5)).astype(np.float32)
+    targets = np.zeros((20, 5), dtype=np.float32)
+    state = rng.choice([-1, 0, 1], size=20)
+    for i in np.where(state == 1)[0]:
+        targets[i, rng.integers(5)] = 1.0
+    got = float(focal_loss(logits, targets, state))
+    want = numpy_focal(logits, targets, state)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_focal_ignore_masking():
+    logits = np.full((2, 3), 5.0, dtype=np.float32)  # confident wrong
+    targets = np.zeros((2, 3), dtype=np.float32)
+    all_ignored = float(focal_loss(logits, targets, np.array([-1, -1])))
+    assert all_ignored == 0.0
+    one_active = float(focal_loss(logits, targets, np.array([-1, 0])))
+    assert one_active > 0.0
+
+
+def test_focal_alpha_gamma_edge_cases():
+    logits = np.array([[0.0]], dtype=np.float32)
+    targets = np.array([[1.0]], dtype=np.float32)
+    state = np.array([1])
+    # gamma=0, alpha=0.5 → plain BCE * 0.5 = 0.5 * log(2)
+    got = float(
+        focal_loss(logits, targets, state, LossConfig(focal_alpha=0.5, focal_gamma=0.0))
+    )
+    np.testing.assert_allclose(got, 0.5 * np.log(2.0), rtol=1e-6)
+
+
+def test_smooth_l1_values_and_normalization():
+    cfg = LossConfig(smooth_l1_beta=1.0 / 9.0)
+    preds = np.array([[0.0, 0.0, 0.0, 0.0], [1.0, 0, 0, 0]], dtype=np.float32)
+    targets = np.array([[0.05, 0, 0, 0], [0.0, 0, 0, 0]], dtype=np.float32)
+    state = np.array([1, 1])
+    beta = 1.0 / 9.0
+    # |d|=0.05 < beta → quadratic; |d|=1 ≥ beta → linear.
+    want = (0.5 * 0.05**2 / beta + (1.0 - 0.5 * beta)) / 2.0
+    got = float(smooth_l1_loss(preds, targets, state, cfg))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_smooth_l1_only_positives():
+    preds = np.ones((3, 4), dtype=np.float32)
+    targets = np.zeros((3, 4), dtype=np.float32)
+    state = np.array([0, -1, 1])
+    got = float(smooth_l1_loss(preds, targets, state))
+    beta = 1.0 / 9.0
+    want = 4 * (1.0 - 0.5 * beta) / 1.0  # only the positive anchor counts
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_total_loss_keys_and_weighting():
+    logits = np.zeros((4, 2), dtype=np.float32)
+    box = np.zeros((4, 4), dtype=np.float32)
+    cls_t = np.zeros((4, 2), dtype=np.float32)
+    box_t = np.ones((4, 4), dtype=np.float32)
+    state = np.array([1, 0, 0, 0])
+    cls_t[0, 1] = 1.0
+    out = total_loss(logits, box, cls_t, box_t, state, LossConfig(box_loss_weight=2.0))
+    np.testing.assert_allclose(
+        float(out["loss"]),
+        float(out["cls_loss"]) + 2.0 * float(out["box_loss"]),
+        rtol=1e-6,
+    )
+
+
+def test_losses_batched_shapes():
+    """Losses accept a leading batch dim (targets computed per-image, vmapped)."""
+    logits = np.zeros((2, 8, 3), dtype=np.float32)
+    box = np.zeros((2, 8, 4), dtype=np.float32)
+    cls_t = np.zeros((2, 8, 3), dtype=np.float32)
+    box_t = np.zeros((2, 8, 4), dtype=np.float32)
+    state = np.zeros((2, 8), dtype=np.int32)
+    out = total_loss(logits, box, cls_t, box_t, state)
+    assert np.isfinite(float(out["loss"]))
+
+
+def test_per_image_normalization():
+    """Crowded images must not dominate: normalize per image, then batch-mean."""
+    A, K = 6, 2
+    logits = np.full((2, A, K), 2.0, dtype=np.float32)
+    cls_t = np.zeros((2, A, K), dtype=np.float32)
+    # image 0: 4 positives; image 1: 1 positive
+    state = np.array([[1, 1, 1, 1, 0, 0], [1, 0, 0, 0, 0, 0]])
+    for b in range(2):
+        for a in range(A):
+            if state[b, a] == 1:
+                cls_t[b, a, 0] = 1.0
+    got = float(focal_loss(logits, cls_t, state))
+    per_image = []
+    for b in range(2):
+        li = numpy_focal(logits[b], cls_t[b], state[b])
+        per_image.append(li)
+    want = np.mean(per_image)
+    np.testing.assert_allclose(got, want, rtol=1e-5)

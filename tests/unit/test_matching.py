@@ -1,0 +1,98 @@
+import numpy as np
+
+from batchai_retinanet_horovod_coco_tpu.ops.matching import (
+    IGNORE,
+    NEGATIVE,
+    POSITIVE,
+    MatchingConfig,
+    anchor_targets,
+    assign_anchors,
+)
+
+
+def test_pos_neg_ignore_thresholds():
+    """Crafted scene hitting all three states exactly (SURVEY.md §4.1)."""
+    gt = np.array([[0, 0, 10, 10]], dtype=np.float32)
+    mask = np.array([True])
+    anchors = np.array(
+        [
+            [0, 0, 10, 10],  # IoU 1.0 → positive
+            [0, 0, 10, 8],  # IoU 0.8 → positive
+            [0, 0, 10, 4.5],  # IoU 0.45 → ignore
+            [0, 5, 10, 16.5],  # IoU ~0.318 → negative (below 0.4)
+            [50, 50, 60, 60],  # IoU 0 → negative
+        ],
+        dtype=np.float32,
+    )
+    out = assign_anchors(anchors, gt, mask, MatchingConfig(force_match_best=False))
+    np.testing.assert_array_equal(
+        np.asarray(out.state), [POSITIVE, POSITIVE, IGNORE, NEGATIVE, NEGATIVE]
+    )
+    assert np.all(np.asarray(out.matched_gt)[:2] == 0)
+
+
+def test_force_match_rescues_low_iou_gt():
+    # gt overlaps best anchor at IoU 0.45 (< 0.5): without force-match no
+    # positives; with it, that anchor becomes positive.
+    gt = np.array([[0, 0, 10, 9]], dtype=np.float32)
+    mask = np.array([True])
+    anchors = np.array([[0, 0, 10, 20], [30, 30, 40, 40]], dtype=np.float32)
+    no_force = assign_anchors(anchors, gt, mask, MatchingConfig(force_match_best=False))
+    assert not np.any(np.asarray(no_force.state) == POSITIVE)
+    forced = assign_anchors(anchors, gt, mask, MatchingConfig(force_match_best=True))
+    assert np.asarray(forced.state)[0] == POSITIVE
+    assert np.asarray(forced.matched_gt)[0] == 0
+
+
+def test_empty_gt_all_negative():
+    gt = np.zeros((3, 4), dtype=np.float32)
+    mask = np.zeros(3, dtype=bool)
+    anchors = np.array([[0, 0, 10, 10], [5, 5, 20, 20]], dtype=np.float32)
+    out = assign_anchors(anchors, gt, mask)
+    np.testing.assert_array_equal(np.asarray(out.state), [NEGATIVE, NEGATIVE])
+
+
+def test_padded_gt_never_matches():
+    gt = np.array([[0, 0, 10, 10], [0, 0, 300, 300]], dtype=np.float32)
+    mask = np.array([True, False])  # second row is padding despite huge box
+    anchors = np.array([[0, 0, 300, 300]], dtype=np.float32)
+    out = assign_anchors(anchors, gt, mask, MatchingConfig(force_match_best=False))
+    # Anchor overlaps the padded row perfectly but must not match it.
+    assert np.asarray(out.state)[0] != POSITIVE or np.asarray(out.matched_gt)[0] == 0
+
+
+def test_anchor_targets_dense_outputs():
+    gt = np.array([[0, 0, 10, 10], [20, 20, 40, 40]], dtype=np.float32)
+    labels = np.array([3, 7], dtype=np.int32)
+    mask = np.array([True, True])
+    anchors = np.array(
+        [[0, 0, 10, 10], [20, 20, 40, 40], [100, 100, 110, 110]], dtype=np.float32
+    )
+    out = anchor_targets(anchors, gt, labels, mask, num_classes=10)
+    cls = np.asarray(out.cls_targets)
+    assert cls.shape == (3, 10)
+    assert cls[0, 3] == 1.0 and cls[0].sum() == 1.0
+    assert cls[1, 7] == 1.0 and cls[1].sum() == 1.0
+    assert cls[2].sum() == 0.0  # negative anchor: all-zero row
+    state = np.asarray(out.state)
+    np.testing.assert_array_equal(state, [POSITIVE, POSITIVE, NEGATIVE])
+    # Perfect matches → zero deltas.
+    np.testing.assert_allclose(np.asarray(out.box_targets)[:2], 0.0, atol=1e-5)
+
+
+def test_force_match_survives_gt_padding():
+    """Padded gt rows must not clobber a forced match at anchor 0.
+
+    Regression: the scatter used to write force=False at anchor 0 for every
+    padded row (argmax of an all-zero IoU column is 0), cancelling the rescue.
+    """
+    gt = np.zeros((3, 4), dtype=np.float32)
+    gt[0] = [0, 0, 10, 9]  # best anchor is anchor 0, IoU 0.45 < 0.5
+    mask = np.array([True, False, False])
+    labels = np.array([2, 0, 0], dtype=np.int32)
+    anchors = np.array([[0, 0, 10, 20], [30, 30, 40, 40]], dtype=np.float32)
+    out = assign_anchors(anchors, gt, mask, MatchingConfig(force_match_best=True))
+    assert np.asarray(out.state)[0] == POSITIVE
+    assert np.asarray(out.matched_gt)[0] == 0
+    tgt = anchor_targets(anchors, gt, labels, mask, num_classes=5)
+    assert np.asarray(tgt.cls_targets)[0, 2] == 1.0

@@ -1,0 +1,90 @@
+import numpy as np
+
+from batchai_retinanet_horovod_coco_tpu.ops.nms import (
+    multiclass_nms,
+    single_class_nms,
+)
+
+
+def numpy_greedy_nms(boxes, scores, iou_thresh):
+    """Reference greedy NMS returning kept indices in score order."""
+    order = np.argsort(-scores, kind="stable")
+    keep = []
+    suppressed = np.zeros(len(boxes), dtype=bool)
+    for i in order:
+        if suppressed[i]:
+            continue
+        keep.append(i)
+        for j in order:
+            if suppressed[j] or j == i:
+                continue
+            bi, bj = boxes[i], boxes[j]
+            ix1, iy1 = max(bi[0], bj[0]), max(bi[1], bj[1])
+            ix2, iy2 = min(bi[2], bj[2]), min(bi[3], bj[3])
+            inter = max(ix2 - ix1, 0) * max(iy2 - iy1, 0)
+            ai = (bi[2] - bi[0]) * (bi[3] - bi[1])
+            aj = (bj[2] - bj[0]) * (bj[3] - bj[1])
+            iou = inter / (ai + aj - inter) if ai + aj - inter > 0 else 0.0
+            if iou > iou_thresh and scores[j] < scores[i]:
+                suppressed[j] = True
+    return keep
+
+
+def test_single_class_matches_numpy_oracle():
+    rng = np.random.default_rng(2)
+    n = 60
+    xy = rng.uniform(0, 80, size=(n, 2))
+    wh = rng.uniform(5, 40, size=(n, 2))
+    boxes = np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+    scores = rng.uniform(0.01, 1.0, size=n).astype(np.float32)
+
+    sel, valid = single_class_nms(boxes, scores, iou_threshold=0.5, max_output=n)
+    got = [int(i) for i, v in zip(np.asarray(sel), np.asarray(valid)) if v]
+    expected = numpy_greedy_nms(boxes, scores, 0.5)
+    assert got == expected
+
+
+def test_single_class_simple_suppression():
+    boxes = np.array(
+        [[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]], dtype=np.float32
+    )
+    scores = np.array([0.9, 0.8, 0.7], dtype=np.float32)
+    sel, valid = single_class_nms(boxes, scores, iou_threshold=0.5, max_output=3)
+    got = [int(i) for i, v in zip(np.asarray(sel), np.asarray(valid)) if v]
+    assert got == [0, 2]  # box 1 suppressed by box 0
+
+
+def test_multiclass_keeps_classes_separate():
+    # Identical boxes, different classes: both survive (class-offset trick).
+    boxes = np.array([[0, 0, 10, 10], [0, 0, 10, 10]], dtype=np.float32)
+    scores = np.array([[0.9, 0.0], [0.0, 0.8]], dtype=np.float32)
+    det = multiclass_nms(boxes, scores, score_threshold=0.05, max_detections=10)
+    valid = np.asarray(det.valid)
+    assert valid.sum() == 2
+    labels = sorted(np.asarray(det.labels)[valid].tolist())
+    assert labels == [0, 1]
+
+
+def test_multiclass_score_threshold_and_order():
+    boxes = np.array(
+        [[0, 0, 10, 10], [20, 20, 30, 30], [40, 40, 50, 50]], dtype=np.float32
+    )
+    scores = np.array(
+        [[0.9, 0.0], [0.02, 0.0], [0.0, 0.5]], dtype=np.float32
+    )  # middle box below 0.05 threshold
+    det = multiclass_nms(boxes, scores, score_threshold=0.05, max_detections=10)
+    valid = np.asarray(det.valid)
+    assert valid.sum() == 2
+    s = np.asarray(det.scores)[valid]
+    assert np.all(np.diff(s) <= 0)  # descending
+    np.testing.assert_allclose(s, [0.9, 0.5], atol=1e-6)
+
+
+def test_multiclass_fixed_output_shape():
+    boxes = np.zeros((100, 4), dtype=np.float32)
+    scores = np.zeros((100, 3), dtype=np.float32)
+    det = multiclass_nms(boxes, scores, max_detections=25)
+    assert det.boxes.shape == (25, 4)
+    assert det.scores.shape == (25,)
+    assert det.labels.shape == (25,)
+    assert not np.any(np.asarray(det.valid))

@@ -1,0 +1,33 @@
+"""Data layer: COCO annotation parsing, synthetic datasets, input pipeline.
+
+Capability parity with the reference's data path (SURVEY.md M8/M9:
+keras-retinanet ``preprocessing/coco.py`` + ``preprocessing/generator.py``),
+redesigned for TPU:
+
+- annotations are parsed with a small self-contained JSON reader (this
+  environment has no pycocotools; SURVEY.md §7);
+- images are resized into a SMALL SET OF STATIC SHAPE BUCKETS instead of
+  per-batch dynamic padding — XLA compiles one program per bucket
+  (SURVEY.md §7.3 hard part 1);
+- anchor targets are NOT computed here: the host ships only images + padded
+  gt boxes, and target assignment runs on device inside the jit'd step
+  (BASELINE.json:5), unlike the reference's CPU loader-thread hot loop
+  (SURVEY.md call stack 3.3).
+"""
+
+from batchai_retinanet_horovod_coco_tpu.data.coco import CocoDataset, ImageRecord
+from batchai_retinanet_horovod_coco_tpu.data.pipeline import (
+    Batch,
+    PipelineConfig,
+    build_pipeline,
+)
+from batchai_retinanet_horovod_coco_tpu.data.synthetic import make_synthetic_coco
+
+__all__ = [
+    "Batch",
+    "CocoDataset",
+    "ImageRecord",
+    "PipelineConfig",
+    "build_pipeline",
+    "make_synthetic_coco",
+]

@@ -1,0 +1,87 @@
+"""COCO instances-JSON parsing without pycocotools.
+
+Parity target: keras-retinanet's ``CocoGenerator`` annotation handling
+(SURVEY.md M9): load instances_*.json, map the sparse COCO category ids onto
+contiguous labels 0..K-1 (sorted by category id, the pycocotools convention),
+and expose per-image boxes/labels.  Boxes are converted from COCO ``[x, y, w,
+h]`` to corner ``[x1, y1, x2, y2]`` once at load time.
+
+Crowd annotations (``iscrowd=1``) are dropped for training, matching the
+reference generator's default behavior.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class ImageRecord:
+    image_id: int
+    file_name: str
+    width: int
+    height: int
+    boxes: np.ndarray  # (N, 4) float32 corner boxes
+    labels: np.ndarray  # (N,) int32 contiguous labels
+
+
+class CocoDataset:
+    """In-memory index of a COCO-format detection dataset."""
+
+    def __init__(
+        self,
+        annotation_file: str,
+        image_dir: str | None = None,
+        include_crowd: bool = False,
+        keep_empty: bool = False,
+    ):
+        with open(annotation_file) as f:
+            blob = json.load(f)
+
+        self.image_dir = image_dir or os.path.dirname(annotation_file)
+        categories = sorted(blob.get("categories", []), key=lambda c: c["id"])
+        self.cat_id_to_label = {c["id"]: i for i, c in enumerate(categories)}
+        self.label_to_cat_id = {i: c["id"] for i, c in enumerate(categories)}
+        self.class_names = [c["name"] for c in categories]
+
+        per_image: dict[int, list[dict]] = {}
+        for ann in blob.get("annotations", []):
+            if not include_crowd and ann.get("iscrowd", 0):
+                continue
+            per_image.setdefault(ann["image_id"], []).append(ann)
+
+        self.records: list[ImageRecord] = []
+        for img in blob.get("images", []):
+            anns = per_image.get(img["id"], [])
+            boxes = np.zeros((len(anns), 4), dtype=np.float32)
+            labels = np.zeros((len(anns),), dtype=np.int32)
+            for i, ann in enumerate(anns):
+                x, y, w, h = ann["bbox"]
+                boxes[i] = [x, y, x + w, y + h]
+                labels[i] = self.cat_id_to_label[ann["category_id"]]
+            if len(anns) == 0 and not keep_empty:
+                continue
+            self.records.append(
+                ImageRecord(
+                    image_id=img["id"],
+                    file_name=img["file_name"],
+                    width=img["width"],
+                    height=img["height"],
+                    boxes=boxes,
+                    labels=labels,
+                )
+            )
+
+    @property
+    def num_classes(self) -> int:
+        return len(self.class_names)
+
+    def __len__(self) -> int:
+        return len(self.records)
+
+    def image_path(self, record: ImageRecord) -> str:
+        return os.path.join(self.image_dir, record.file_name)

@@ -1,0 +1,283 @@
+"""Host input pipeline: decode → augment → resize → bucket-pad → batch.
+
+Parity target: keras-retinanet's ``Generator`` hot loop (SURVEY.md M8, call
+stack 3.3) — JPEG decode, random flip, aspect-preserving resize to
+min-side/max-side (800/1333 for the flagship config, BASELINE.json:10), and
+batching — minus everything the TPU rebuild moves on device (anchor targets).
+
+TPU-first redesign decisions:
+- **Static shape buckets** (SURVEY.md §7.3 hard part 1): every image is
+  resized (aspect preserved) then padded into one of a small set of fixed
+  (H, W) buckets chosen by aspect ratio; batches are formed within a bucket,
+  so XLA compiles one program per bucket instead of one per unique padded
+  shape.
+- GT boxes are padded to a fixed ``max_gt`` with a validity mask; target
+  assignment happens on device.
+- Normalization is ImageNet-style RGB mean/std (a redesign of the reference's
+  caffe BGR mean-subtract; the convention only needs to match the backbone
+  init, which is ours).
+- Deterministic: one PRNG per (seed, epoch); multi-host sharding is plain
+  index sharding by ``process_index`` (the grain/tf.data idiom), replacing
+  the reference's implicit per-rank generator seeding.
+- Decode + resize fan out over a thread pool; batches are prefetched by a
+  background thread into a bounded queue (the reference used Keras'
+  ``fit_generator`` worker pool).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+from collections.abc import Iterator
+from concurrent.futures import ThreadPoolExecutor
+from typing import NamedTuple
+
+import numpy as np
+
+from batchai_retinanet_horovod_coco_tpu.data.coco import CocoDataset, ImageRecord
+
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], dtype=np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], dtype=np.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineConfig:
+    batch_size: int = 2
+    # (H, W) buckets; an image goes to the first bucket whose aspect class
+    # matches (landscape → wide bucket, portrait → tall, near-square → square).
+    buckets: tuple[tuple[int, int], ...] = ((800, 1344), (1344, 800), (1024, 1024))
+    min_side: int = 800
+    max_side: int = 1333
+    max_gt: int = 100
+    hflip_prob: float = 0.5
+    shuffle: bool = True
+    seed: int = 0
+    # Multi-host sharding: this process sees records[shard_index::shard_count].
+    shard_index: int = 0
+    shard_count: int = 1
+    num_workers: int = 8
+    prefetch: int = 2
+    drop_remainder: bool = True
+
+
+class Batch(NamedTuple):
+    images: np.ndarray  # (B, H, W, 3) float32, normalized
+    gt_boxes: np.ndarray  # (B, max_gt, 4) float32, resized coords
+    gt_labels: np.ndarray  # (B, max_gt) int32
+    gt_mask: np.ndarray  # (B, max_gt) bool
+    image_ids: np.ndarray  # (B,) int64
+    scales: np.ndarray  # (B,) float32: resized / original
+    valid: np.ndarray  # (B,) bool: False for eval padding rows
+
+
+def resize_scale(h: int, w: int, min_side: int, max_side: int) -> float:
+    """Reference resize rule: scale so min side = min_side, capped by max_side."""
+    scale = min_side / min(h, w)
+    if scale * max(h, w) > max_side:
+        scale = max_side / max(h, w)
+    return scale
+
+
+def pick_bucket(
+    h: int, w: int, buckets: tuple[tuple[int, int], ...]
+) -> tuple[int, int]:
+    """Smallest bucket that fits (h, w); falls back to the largest-area one."""
+    fitting = [b for b in buckets if b[0] >= h and b[1] >= w]
+    if fitting:
+        return min(fitting, key=lambda b: b[0] * b[1])
+    return max(buckets, key=lambda b: b[0] * b[1])
+
+
+def load_example(
+    dataset: CocoDataset,
+    record: ImageRecord,
+    config: PipelineConfig,
+    rng: np.random.Generator | None,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, float, tuple[int, int]]:
+    """Decode + (train-only) flip + resize one image.
+
+    Returns (image f32 HWC normalized, boxes (N,4) resized, labels, scale,
+    bucket_hw).  The image is NOT yet padded to the bucket.
+    """
+    from PIL import Image
+
+    with Image.open(dataset.image_path(record)) as im:
+        image = np.asarray(im.convert("RGB"), dtype=np.uint8)
+    boxes = record.boxes.copy()
+    labels = record.labels.copy()
+    h, w = image.shape[:2]
+
+    if rng is not None and config.hflip_prob > 0 and rng.random() < config.hflip_prob:
+        image = image[:, ::-1]
+        x1 = boxes[:, 0].copy()
+        boxes[:, 0] = w - boxes[:, 2]
+        boxes[:, 2] = w - x1
+
+    scale = resize_scale(h, w, config.min_side, config.max_side)
+    nh, nw = int(round(h * scale)), int(round(w * scale))
+    if (nh, nw) != (h, w):
+        image = np.asarray(
+            Image.fromarray(image).resize((nw, nh), Image.BILINEAR), dtype=np.uint8
+        )
+        boxes = boxes * scale
+    bucket = pick_bucket(nh, nw, config.buckets)
+    normalized = (image.astype(np.float32) / 255.0 - IMAGENET_MEAN) / IMAGENET_STD
+    return normalized, boxes, labels, scale, bucket
+
+
+def _assemble(
+    examples: list[tuple[np.ndarray, np.ndarray, np.ndarray, float, tuple[int, int]]],
+    image_ids: list[int],
+    bucket: tuple[int, int],
+    config: PipelineConfig,
+) -> Batch:
+    b = len(examples)
+    bh, bw = bucket
+    images = np.zeros((b, bh, bw, 3), dtype=np.float32)
+    gt_boxes = np.zeros((b, config.max_gt, 4), dtype=np.float32)
+    gt_labels = np.zeros((b, config.max_gt), dtype=np.int32)
+    gt_mask = np.zeros((b, config.max_gt), dtype=bool)
+    scales = np.zeros((b,), dtype=np.float32)
+    for i, (img, boxes, labels, scale, _) in enumerate(examples):
+        h, w = img.shape[:2]
+        images[i, :h, :w] = img
+        n = min(len(boxes), config.max_gt)
+        gt_boxes[i, :n] = boxes[:n]
+        gt_labels[i, :n] = labels[:n]
+        gt_mask[i, :n] = True
+        scales[i] = scale
+    return Batch(
+        images=images,
+        gt_boxes=gt_boxes,
+        gt_labels=gt_labels,
+        gt_mask=gt_mask,
+        image_ids=np.asarray(image_ids, dtype=np.int64),
+        scales=scales,
+        valid=np.ones((b,), dtype=bool),
+    )
+
+
+def build_pipeline(
+    dataset: CocoDataset,
+    config: PipelineConfig,
+    train: bool = True,
+) -> Iterator[Batch]:
+    """Infinite (train) or single-epoch (eval) iterator of bucketed batches.
+
+    Train: shuffles per epoch, groups records by bucket, yields full batches.
+    Eval: preserves order, no augmentation, pads the final batch with
+    ``valid=False`` rows so every record is evaluated exactly once.
+    """
+
+    def example_rng(epoch: int, idx: int) -> np.random.Generator | None:
+        if not train:
+            return None
+        return np.random.default_rng(
+            np.random.SeedSequence([config.seed, epoch, idx])
+        )
+
+    def epoch_indices(epoch: int) -> list[int]:
+        idx = np.arange(len(dataset.records))
+        if train and config.shuffle:
+            np.random.default_rng(
+                np.random.SeedSequence([config.seed, epoch])
+            ).shuffle(idx)
+        return list(idx[config.shard_index :: config.shard_count])
+
+    def record_bucket(record: ImageRecord) -> tuple[int, int]:
+        scale = resize_scale(
+            record.height, record.width, config.min_side, config.max_side
+        )
+        nh = int(round(record.height * scale))
+        nw = int(round(record.width * scale))
+        return pick_bucket(nh, nw, config.buckets)
+
+    out: queue.Queue = queue.Queue(maxsize=max(1, config.prefetch))
+    stop = threading.Event()
+    _SENTINEL = object()
+
+    def producer() -> None:
+        pool = ThreadPoolExecutor(max_workers=config.num_workers)
+        try:
+            _produce(pool)
+        except BaseException as exc:  # propagate to the consumer; never hang
+            out.put(exc)
+        finally:
+            pool.shutdown(wait=False)
+
+    def _produce(pool: ThreadPoolExecutor) -> None:
+            epoch = 0
+            while not stop.is_set():
+                indices = epoch_indices(epoch)
+                by_bucket: dict[tuple[int, int], list[int]] = {}
+                for i in indices:
+                    by_bucket.setdefault(
+                        record_bucket(dataset.records[i]), []
+                    ).append(i)
+                for bucket, idxs in by_bucket.items():
+                    for start in range(0, len(idxs), config.batch_size):
+                        chunk = idxs[start : start + config.batch_size]
+                        if len(chunk) < config.batch_size and (
+                            train and config.drop_remainder
+                        ):
+                            continue
+                        futures = [
+                            pool.submit(
+                                load_example,
+                                dataset,
+                                dataset.records[i],
+                                config,
+                                example_rng(epoch, int(i)),
+                            )
+                            for i in chunk
+                        ]
+                        examples = [f.result() for f in futures]
+                        ids = [dataset.records[i].image_id for i in chunk]
+                        batch = _assemble(examples, ids, bucket, config)
+                        if not train and len(chunk) < config.batch_size:
+                            batch = _pad_batch(batch, config.batch_size)
+                        if stop.is_set():
+                            return
+                        out.put(batch)
+                if not train:
+                    out.put(_SENTINEL)
+                    return
+                epoch += 1
+
+    thread = threading.Thread(target=producer, daemon=True)
+    thread.start()
+
+    def iterate() -> Iterator[Batch]:
+        try:
+            while True:
+                item = out.get()
+                if item is _SENTINEL:
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+
+    return iterate()
+
+
+def _pad_batch(batch: Batch, batch_size: int) -> Batch:
+    """Pad a short eval batch to full size with valid=False rows."""
+    b = batch.images.shape[0]
+    pad = batch_size - b
+
+    def pad0(x: np.ndarray) -> np.ndarray:
+        widths = [(0, pad)] + [(0, 0)] * (x.ndim - 1)
+        return np.pad(x, widths)
+
+    return Batch(
+        images=pad0(batch.images),
+        gt_boxes=pad0(batch.gt_boxes),
+        gt_labels=pad0(batch.gt_labels),
+        gt_mask=pad0(batch.gt_mask),
+        image_ids=pad0(batch.image_ids),
+        scales=pad0(batch.scales),
+        valid=np.concatenate([batch.valid, np.zeros(pad, dtype=bool)]),
+    )

@@ -1,0 +1,91 @@
+"""Synthetic COCO-format datasets for tests, overfit runs, and benchmarks.
+
+The reference validated against real COCO mounted from blob storage
+(SURVEY.md W2); this air-gapped environment has no COCO, so we generate a
+deterministic synthetic detection dataset — colored axis-aligned rectangles
+on noise backgrounds, with class identity encoded in the rectangle's color —
+written as real JPEG files + instances.json so the FULL pipeline (JPEG
+decode, resize, bucketing, eval-JSON round trip) is exercised end to end.
+An overfit run on this dataset is the capability analogue of the reference's
+COCO-mini config (BASELINE.json configs[1]).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+# A fixed palette: class k gets a distinct hue so the task is learnable.
+_PALETTE = [
+    (220, 40, 40),
+    (40, 220, 40),
+    (40, 40, 220),
+    (220, 220, 40),
+    (220, 40, 220),
+    (40, 220, 220),
+    (240, 140, 20),
+    (140, 20, 240),
+]
+
+
+def make_synthetic_coco(
+    root: str,
+    num_images: int = 64,
+    num_classes: int = 3,
+    image_size: tuple[int, int] = (256, 256),
+    max_objects: int = 4,
+    seed: int = 0,
+    split: str = "train",
+) -> str:
+    """Write a synthetic COCO dataset under ``root``; returns annotation path."""
+    from PIL import Image
+
+    assert num_classes <= len(_PALETTE)
+    rng = np.random.default_rng(seed)
+    img_dir = os.path.join(root, split)
+    os.makedirs(img_dir, exist_ok=True)
+
+    images, annotations = [], []
+    ann_id = 1
+    h, w = image_size
+    for image_id in range(1, num_images + 1):
+        canvas = rng.integers(90, 120, size=(h, w, 3), dtype=np.uint8)
+        n_obj = int(rng.integers(1, max_objects + 1))
+        for _ in range(n_obj):
+            bw = int(rng.integers(max(8, w // 8), w // 2))
+            bh = int(rng.integers(max(8, h // 8), h // 2))
+            x1 = int(rng.integers(0, w - bw))
+            y1 = int(rng.integers(0, h - bh))
+            label = int(rng.integers(0, num_classes))
+            color = _PALETTE[label]
+            canvas[y1 : y1 + bh, x1 : x1 + bw] = color
+            annotations.append(
+                {
+                    "id": ann_id,
+                    "image_id": image_id,
+                    "category_id": label + 1,
+                    "bbox": [float(x1), float(y1), float(bw), float(bh)],
+                    "area": float(bw * bh),
+                    "iscrowd": 0,
+                }
+            )
+            ann_id += 1
+        file_name = f"{image_id:06d}.jpg"
+        Image.fromarray(canvas).save(os.path.join(img_dir, file_name), quality=92)
+        images.append(
+            {"id": image_id, "file_name": file_name, "width": w, "height": h}
+        )
+
+    blob = {
+        "images": images,
+        "annotations": annotations,
+        "categories": [
+            {"id": k + 1, "name": f"class{k}"} for k in range(num_classes)
+        ],
+    }
+    ann_path = os.path.join(root, f"instances_{split}.json")
+    with open(ann_path, "w") as f:
+        json.dump(blob, f)
+    return ann_path

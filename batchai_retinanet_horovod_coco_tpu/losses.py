@@ -2,10 +2,19 @@
 
 Capability parity with keras-retinanet ``losses.py`` (SURVEY.md M4):
 - focal loss with alpha=0.25, gamma=2.0, computed on sigmoid logits over all
-  non-ignored anchors, normalized by the per-image positive-anchor count
-  (min 1) and averaged over the batch;
-- smooth-L1 with sigma=3 (beta = 1/sigma^2) on positive anchors only, with the
-  same per-image normalization.
+  non-ignored anchors;
+- smooth-L1 with sigma=3 (beta = 1/sigma^2) on positive anchors only.
+
+Normalization — DELIBERATE divergence from keras-retinanet: the reference
+divides the batch-wide loss sum by the batch-wide positive count; we
+normalize by the PER-IMAGE positive count (min 1) and then average over the
+batch.  This (a) matches the RetinaNet paper's definition ("the total focal
+loss of an image, normalized by the number of anchors assigned to
+ground-truth boxes"), and (b) is exactly invariant under data-parallel
+sharding: mean-over-images equals pmean of per-shard means regardless of how
+positives distribute across shards, so the sharded step is bitwise-comparable
+to the single-device step (tests/distributed/test_train_step.py).  The
+reference's batch-global normalizer is NOT DP-invariant.
 
 TPU-first differences from the reference:
 - Losses consume the dense fixed-shape targets produced on device by
@@ -62,8 +71,8 @@ def focal_loss(
     not_ignored = (anchor_state != matching.IGNORE).astype(jnp.float32)
     loss = loss * not_ignored[..., None]
 
-    # Reference parity: normalize by the PER-IMAGE positive count (min 1), then
-    # average over the batch, so crowded images don't dominate the gradient.
+    # Per-image normalization then batch mean (paper semantics, DP-invariant;
+    # deliberate divergence from keras-retinanet — see module docstring).
     per_image = jnp.sum(loss, axis=(-2, -1))
     num_pos = jnp.sum(
         (anchor_state == matching.POSITIVE).astype(jnp.float32), axis=-1

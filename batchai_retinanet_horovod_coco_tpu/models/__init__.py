@@ -1,0 +1,27 @@
+"""Flax model zoo: ResNet backbones, FPN, RetinaNet heads.
+
+Capability parity with the reference's model layer (SURVEY.md M1-M3:
+keras-retinanet ``models/resnet.py`` + ``models/retinanet.py``), redesigned
+for TPU: NHWC layouts, bfloat16 compute with float32 params, GroupNorm or
+(frozen) BatchNorm, everything traced once under jit with static shapes.
+"""
+
+from batchai_retinanet_horovod_coco_tpu.models.fpn import FPN
+from batchai_retinanet_horovod_coco_tpu.models.heads import BoxHead, ClassificationHead
+from batchai_retinanet_horovod_coco_tpu.models.resnet import ResNet, resnet50
+from batchai_retinanet_horovod_coco_tpu.models.retinanet import (
+    RetinaNet,
+    RetinaNetConfig,
+    build_retinanet,
+)
+
+__all__ = [
+    "FPN",
+    "BoxHead",
+    "ClassificationHead",
+    "ResNet",
+    "RetinaNet",
+    "RetinaNetConfig",
+    "build_retinanet",
+    "resnet50",
+]

@@ -1,0 +1,57 @@
+"""Feature Pyramid Network producing P3..P7 from C3..C5.
+
+Parity target: keras-retinanet's ``__create_pyramid_features`` (SURVEY.md M1):
+lateral 1x1 convs, nearest-neighbor top-down pathway, 3x3 output convs, plus
+P6 = stride-2 conv on C5 and P7 = relu + stride-2 conv on P6.
+
+Upsampling resizes to the exact lateral shape (jax.image.resize, nearest),
+which keeps odd/ceil dimensions consistent with SAME-padded stride arithmetic
+— XLA lowers this to a cheap gather with static shapes.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class FPN(nn.Module):
+    """C3..C5 → P3..P7, all with ``channels`` features."""
+
+    channels: int = 256
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, features: dict[str, jnp.ndarray]) -> dict[str, jnp.ndarray]:
+        conv = lambda f, k, s, name: nn.Conv(  # noqa: E731
+            f,
+            (k, k),
+            strides=(s, s),
+            padding="SAME",
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name=name,
+        )
+        c3, c4, c5 = features["c3"], features["c4"], features["c5"]
+
+        m5 = conv(self.channels, 1, 1, "lateral_c5")(c5)
+        m4 = conv(self.channels, 1, 1, "lateral_c4")(c4)
+        m3 = conv(self.channels, 1, 1, "lateral_c3")(c3)
+
+        m4 = m4 + _upsample_to(m5, m4.shape)
+        m3 = m3 + _upsample_to(m4, m3.shape)
+
+        p3 = conv(self.channels, 3, 1, "out_p3")(m3)
+        p4 = conv(self.channels, 3, 1, "out_p4")(m4)
+        p5 = conv(self.channels, 3, 1, "out_p5")(m5)
+        p6 = conv(self.channels, 3, 2, "out_p6")(c5)
+        p7 = conv(self.channels, 3, 2, "out_p7")(nn.relu(p6))
+        return {"p3": p3, "p4": p4, "p5": p5, "p6": p6, "p7": p7}
+
+
+def _upsample_to(x: jnp.ndarray, target_shape: tuple[int, ...]) -> jnp.ndarray:
+    """Nearest-neighbor upsample NHWC ``x`` to the target H, W."""
+    b, _, _, c = x.shape
+    th, tw = target_shape[1], target_shape[2]
+    return jax.image.resize(x, (b, th, tw, c), method="nearest")

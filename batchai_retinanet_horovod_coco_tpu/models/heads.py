@@ -1,0 +1,75 @@
+"""Shared classification and box-regression subnets.
+
+Parity target: keras-retinanet's ``default_classification_model`` /
+``default_regression_model`` (SURVEY.md M1): depth-4 conv-256 subnets shared
+across pyramid levels; the classification head's final bias is initialized to
+-log((1-pi)/pi) with pi=0.01 so training starts with ~1% foreground
+probability (the RetinaNet prior trick), and outputs are raw logits (the loss
+is computed on logits; apply sigmoid only at inference).
+
+The heads are flax modules applied to each level with the SAME parameters
+(weight sharing falls out of calling one module instance on every level
+inside RetinaNet).
+"""
+
+from __future__ import annotations
+
+import math
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def _head_conv(features: int, name: str, dtype, bias_init=nn.initializers.zeros):
+    return nn.Conv(
+        features,
+        (3, 3),
+        padding="SAME",
+        dtype=dtype,
+        param_dtype=jnp.float32,
+        kernel_init=nn.initializers.normal(stddev=0.01),
+        bias_init=bias_init,
+        name=name,
+    )
+
+
+class ClassificationHead(nn.Module):
+    num_classes: int
+    anchors_per_location: int = 9
+    width: int = 256
+    depth: int = 4
+    prior_prob: float = 0.01
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        """(B, H, W, C) → (B, H*W*anchors, num_classes) logits."""
+        for i in range(self.depth):
+            x = _head_conv(self.width, f"conv{i}", self.dtype)(x)
+            x = nn.relu(x)
+        bias = -math.log((1.0 - self.prior_prob) / self.prior_prob)
+        x = _head_conv(
+            self.num_classes * self.anchors_per_location,
+            "logits",
+            self.dtype,
+            bias_init=nn.initializers.constant(bias),
+        )(x)
+        b, h, w, _ = x.shape
+        return x.reshape(b, h * w * self.anchors_per_location, self.num_classes)
+
+
+class BoxHead(nn.Module):
+    anchors_per_location: int = 9
+    width: int = 256
+    depth: int = 4
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        """(B, H, W, C) → (B, H*W*anchors, 4) deltas."""
+        for i in range(self.depth):
+            x = _head_conv(self.width, f"conv{i}", self.dtype)(x)
+            x = nn.relu(x)
+        x = _head_conv(4 * self.anchors_per_location, "deltas", self.dtype)(x)
+        b, h, w, _ = x.shape
+        return x.reshape(b, h * w * self.anchors_per_location, 4)

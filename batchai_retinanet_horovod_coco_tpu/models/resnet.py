@@ -1,0 +1,136 @@
+"""ResNet backbone (v1.5 bottleneck) exposing C3, C4, C5 feature maps.
+
+Parity target: keras-retinanet's ResNet-50 backbone (SURVEY.md M2,
+``models/resnet.py`` + the keras-resnet dependency), which feeds C3..C5 into
+the FPN and freezes BatchNorm during detection fine-tuning.
+
+TPU-first design:
+- NHWC layout (XLA:TPU's native conv layout), bfloat16 activations with
+  float32 params by default — convs hit the MXU in bf16.
+- Norm is pluggable:
+  * ``"gn"`` (default): GroupNorm(32) — batch-size independent, no mutable
+    state, the right choice for from-scratch training in an air-gapped env
+    (SURVEY.md §7.3 hard part 5);
+  * ``"bn"``: BatchNorm with running stats (mutable ``batch_stats``);
+  * ``"frozen_bn"``: running-stats-only BatchNorm (never updates), matching
+    the reference's frozen-BN fine-tuning recipe when pretrained weights are
+    supplied.
+- Strided 3x3 in the bottleneck's middle conv (v1.5), SAME padding so spatial
+  dims follow ceil(H/stride) — consistent with ops.anchors.feature_shape.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable, Sequence
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class NormFactory:
+    """Builds the configured norm layer; see module docstring for options."""
+
+    def __init__(self, kind: str, dtype: jnp.dtype):
+        if kind not in ("gn", "bn", "frozen_bn"):
+            raise ValueError(f"unknown norm kind: {kind!r}")
+        self.kind = kind
+        self.dtype = dtype
+
+    def __call__(self, parent: nn.Module, name: str, train: bool) -> Callable:
+        if self.kind == "gn":
+            return nn.GroupNorm(
+                num_groups=32, dtype=self.dtype, name=name, param_dtype=jnp.float32
+            )
+        use_running = (self.kind == "frozen_bn") or (not train)
+        return nn.BatchNorm(
+            use_running_average=use_running,
+            momentum=0.9,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name=name,
+        )
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 → 3x3(stride) → 1x1(x4) with projection shortcut on shape change."""
+
+    filters: int
+    stride: int
+    norm: NormFactory
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        conv = lambda f, k, s, name: nn.Conv(  # noqa: E731
+            f,
+            (k, k),
+            strides=(s, s),
+            padding="SAME",
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name=name,
+        )
+        residual = x
+        y = conv(self.filters, 1, 1, "conv1")(x)
+        y = self.norm(self, "norm1", train)(y)
+        y = nn.relu(y)
+        y = conv(self.filters, 3, self.stride, "conv2")(y)
+        y = self.norm(self, "norm2", train)(y)
+        y = nn.relu(y)
+        y = conv(self.filters * 4, 1, 1, "conv3")(y)
+        y = self.norm(self, "norm3", train)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters * 4, 1, self.stride, "proj")(x)
+            residual = self.norm(self, "proj_norm", train)(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """ResNet exposing {"c3", "c4", "c5"} (strides 8/16/32)."""
+
+    stage_sizes: Sequence[int]
+    norm_kind: str = "gn"
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> dict[str, jnp.ndarray]:
+        norm = NormFactory(self.norm_kind, self.dtype)
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            64,
+            (7, 7),
+            strides=(2, 2),
+            padding="SAME",
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name="stem_conv",
+        )(x)
+        x = norm(self, "stem_norm", train)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+
+        features: dict[str, jnp.ndarray] = {}
+        filters = 64
+        for stage, num_blocks in enumerate(self.stage_sizes):
+            stride = 1 if stage == 0 else 2
+            for block in range(num_blocks):
+                x = BottleneckBlock(
+                    filters=filters,
+                    stride=stride if block == 0 else 1,
+                    norm=norm,
+                    dtype=self.dtype,
+                    name=f"stage{stage + 2}_block{block}",
+                )(x, train=train)
+            if stage >= 1:  # C3 at stride 8, C4 at 16, C5 at 32
+                features[f"c{stage + 2}"] = x
+            filters *= 2
+        return features
+
+
+def resnet50(norm_kind: str = "gn", dtype: jnp.dtype = jnp.bfloat16) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), norm_kind=norm_kind, dtype=dtype)

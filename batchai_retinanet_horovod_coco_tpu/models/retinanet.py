@@ -1,0 +1,106 @@
+"""RetinaNet assembly: backbone → FPN → shared heads → concatenated outputs.
+
+Parity target: keras-retinanet's ``retinanet()`` graph builder (SURVEY.md M1).
+The training model outputs, per image, dense per-anchor classification logits
+(A, K) and box deltas (A, 4), concatenated over pyramid levels P3→P7 in the
+SAME anchor order as ``ops.anchors.anchors_for_image_shape``: level-major,
+then row-major over (y, x), then the 9 anchors of a location.  This ordering
+contract is what lets targets/anchors be plain constants alongside the model
+outputs; it is locked in by tests (tests/unit/test_model.py).
+
+Unlike the reference there is no separate "bbox model" conversion step
+(SURVEY.md M3): inference is just another jitted function over the same
+params (evaluate/detect.py) since decode+NMS are ordinary device ops here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from batchai_retinanet_horovod_coco_tpu.models.fpn import FPN
+from batchai_retinanet_horovod_coco_tpu.models.heads import BoxHead, ClassificationHead
+from batchai_retinanet_horovod_coco_tpu.models.resnet import ResNet
+from batchai_retinanet_horovod_coco_tpu.ops.anchors import AnchorConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class RetinaNetConfig:
+    num_classes: int = 80
+    backbone: str = "resnet50"
+    norm_kind: str = "gn"  # "gn" | "bn" | "frozen_bn"  (see models/resnet.py)
+    fpn_channels: int = 256
+    head_width: int = 256
+    head_depth: int = 4
+    prior_prob: float = 0.01
+    anchor: AnchorConfig = AnchorConfig()
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def anchors_per_location(self) -> int:
+        return self.anchor.num_anchors_per_location
+
+
+_BACKBONE_STAGES = {
+    "resnet18": None,  # not a bottleneck net; unsupported, kept for error msg
+    "resnet50": (3, 4, 6, 3),
+    "resnet101": (3, 4, 23, 3),
+    "resnet152": (3, 8, 36, 3),
+    # One block per stage: for fast CI on the virtual CPU mesh only.
+    "resnet_test": (1, 1, 1, 1),
+}
+
+
+class RetinaNet(nn.Module):
+    config: RetinaNetConfig
+
+    @nn.compact
+    def __call__(self, images: jnp.ndarray, train: bool = False) -> dict[str, jnp.ndarray]:
+        """(B, H, W, 3) float images → {"cls_logits": (B, A, K), "box_deltas": (B, A, 4)}."""
+        cfg = self.config
+        stages = _BACKBONE_STAGES.get(cfg.backbone)
+        if stages is None:
+            raise ValueError(f"unsupported backbone: {cfg.backbone!r}")
+        features = ResNet(
+            stage_sizes=stages,
+            norm_kind=cfg.norm_kind,
+            dtype=cfg.dtype,
+            name="backbone",
+        )(images, train=train)
+        pyramid = FPN(channels=cfg.fpn_channels, dtype=cfg.dtype, name="fpn")(features)
+
+        cls_head = ClassificationHead(
+            num_classes=cfg.num_classes,
+            anchors_per_location=cfg.anchors_per_location,
+            width=cfg.head_width,
+            depth=cfg.head_depth,
+            prior_prob=cfg.prior_prob,
+            dtype=cfg.dtype,
+            name="cls_head",
+        )
+        box_head = BoxHead(
+            anchors_per_location=cfg.anchors_per_location,
+            width=cfg.head_width,
+            depth=cfg.head_depth,
+            dtype=cfg.dtype,
+            name="box_head",
+        )
+
+        cls_out, box_out = [], []
+        for level in cfg.anchor.levels:  # P3 → P7, matching anchor order
+            feat = pyramid[f"p{level}"]
+            cls_out.append(cls_head(feat))
+            box_out.append(box_head(feat))
+
+        return {
+            # Losses run in f32; cast once here so downstream ops are f32.
+            "cls_logits": jnp.concatenate(cls_out, axis=1).astype(jnp.float32),
+            "box_deltas": jnp.concatenate(box_out, axis=1).astype(jnp.float32),
+        }
+
+
+def build_retinanet(config: RetinaNetConfig | None = None) -> RetinaNet:
+    return RetinaNet(config=config or RetinaNetConfig())

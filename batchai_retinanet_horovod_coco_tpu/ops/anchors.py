@@ -102,7 +102,9 @@ def _anchors_cached(image_hw: tuple[int, int], config: AnchorConfig) -> np.ndarr
         base = generate_base_anchors(config.sizes[i], config.ratios, config.scales)
         feat_hw = config.feature_shape(image_hw, level)
         per_level.append(_anchors_for_level(feat_hw, config.strides[i], base))
-    return np.concatenate(per_level, axis=0)
+    out = np.concatenate(per_level, axis=0)
+    out.setflags(write=False)  # shared cached array: in-place edits would
+    return out  # silently corrupt every later caller
 
 
 def anchors_for_image_shape(

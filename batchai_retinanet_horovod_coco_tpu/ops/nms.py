@@ -122,6 +122,15 @@ def multiclass_nms(
     )
 
 
-batched_multiclass_nms = jax.vmap(
-    multiclass_nms, in_axes=(0, 0), out_axes=0
-)
+def batched_multiclass_nms(
+    boxes: jnp.ndarray,
+    cls_scores: jnp.ndarray,
+    **kwargs,
+) -> Detections:
+    """vmap of :func:`multiclass_nms` over a leading batch axis.
+
+    Config kwargs are closed over (static), not mapped — passing e.g.
+    ``score_threshold=0.1`` works, unlike a bare ``jax.vmap`` with scalar
+    kwargs.
+    """
+    return jax.vmap(lambda b, s: multiclass_nms(b, s, **kwargs))(boxes, cls_scores)

@@ -1,0 +1,30 @@
+"""Distributed execution: device meshes, shardings, compiled collectives.
+
+This package is the TPU-native replacement for the reference's ENTIRE
+distributed runtime (SURVEY.md §2.3: Horovod python API H1, C++ core H2, NCCL
+backend H3, MPI control plane H4):
+
+- Horovod's background coordinator + tensor-fusion buffers have NO runtime
+  equivalent here — gradient allreduce is ``jax.lax.pmean`` inside the
+  jit-compiled step, which XLA fuses, schedules, and overlaps with backward
+  compute at COMPILE TIME (the compile-time analogue of Horovod's fusion
+  buffer, SURVEY.md H2);
+- NCCL rings become ICI collectives emitted by XLA for the mesh's ``data``
+  axis (DCN across pod slices);
+- ``mpirun`` + MPI rank negotiation become ``jax.distributed.initialize``
+  (see ``launch/pod.py``).
+"""
+
+from batchai_retinanet_horovod_coco_tpu.parallel.mesh import (
+    DATA_AXIS,
+    batch_sharding,
+    make_mesh,
+    replicated_sharding,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "batch_sharding",
+    "make_mesh",
+    "replicated_sharding",
+]

@@ -1,0 +1,43 @@
+"""Device mesh construction and canonical shardings.
+
+The flagship parallelism strategy is pure data-parallel over a 1-D ``data``
+axis (SURVEY.md §2.4: DP is the only strategy the reference uses; TP/PP/SP
+are deliberately not built for RetinaNet-R50, which fits per chip).  The mesh
+abstraction still goes through ``jax.sharding.Mesh`` so that wider meshes
+(e.g. a future ``spatial`` axis for XLA SPMD partitioning of very large
+images) slot in without touching call sites.
+
+Multi-host: ``jax.devices()`` returns the GLOBAL device list after
+``jax.distributed.initialize`` (launch/pod.py), so the same mesh code serves
+1 chip, one host with 8 chips, and a v5e-256 pod slice unchanged.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DATA_AXIS = "data"
+
+
+def make_mesh(num_devices: int | None = None) -> Mesh:
+    """1-D data-parallel mesh over the first ``num_devices`` global devices."""
+    devices = jax.devices()
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"requested {num_devices} devices, have {len(devices)}"
+            )
+        devices = devices[:num_devices]
+    return Mesh(np.asarray(devices), axis_names=(DATA_AXIS,))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch) axis over the data axis."""
+    return NamedSharding(mesh, PartitionSpec(DATA_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully replicated (params, optimizer state, scalars)."""
+    return NamedSharding(mesh, PartitionSpec())

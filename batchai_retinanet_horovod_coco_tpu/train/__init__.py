@@ -1,0 +1,17 @@
+"""Training: state, SPMD step, loop.
+
+Replaces the reference's L2/L3 training layers (SURVEY.md: Keras
+``model.compile/fit_generator`` + ``hvd.DistributedOptimizer``) with a
+functional JAX loop: an optax optimizer, an explicit TrainState pytree, and
+ONE jit-compiled SPMD train step per shape bucket.
+"""
+
+from batchai_retinanet_horovod_coco_tpu.train.state import TrainState, create_train_state
+from batchai_retinanet_horovod_coco_tpu.train.step import make_eval_forward, make_train_step
+
+__all__ = [
+    "TrainState",
+    "create_train_state",
+    "make_eval_forward",
+    "make_train_step",
+]

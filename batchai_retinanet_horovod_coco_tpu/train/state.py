@@ -1,0 +1,68 @@
+"""Train state pytree: params, optional BN stats, optimizer state, step.
+
+The reference kept this state implicit inside Keras/Horovod (SURVEY.md L2/H1:
+model weights + replicated optimizer slots, synced by broadcast at start).
+Here it is an explicit pytree, so sharding it (replicated today; optionally
+optimizer-state-sharded over the data axis later, SURVEY.md §2.4 ZeRO row) is
+a matter of NamedSharding annotations, and checkpointing is orbax on the
+whole pytree (SURVEY.md §5.4).
+
+Initial-weight sync across hosts is free by construction: every process
+builds params from the same PRNG key, so there is no broadcast step (the
+reference needed ``hvd.broadcast_global_variables``, SURVEY.md H1).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jnp.ndarray
+    params: Any
+    batch_stats: Any  # empty dict for GN models
+    opt_state: Any
+    # Static (non-pytree) fields:
+    tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+
+    def apply_gradients(self, grads: Any, new_batch_stats: Any | None = None):
+        updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(
+            step=self.step + 1,
+            params=new_params,
+            batch_stats=(
+                new_batch_stats if new_batch_stats is not None else self.batch_stats
+            ),
+            opt_state=new_opt_state,
+        )
+
+
+def create_train_state(
+    model,
+    tx: optax.GradientTransformation,
+    example_image_shape: tuple[int, int, int, int],
+    rng: jax.Array,
+) -> TrainState:
+    """Initialize params; identical on every process (same PRNG key).
+
+    ``model.init`` is wrapped in jit: eager init dispatches thousands of tiny
+    ops, which is pathological on remote/tunneled TPU backends (measured
+    ~4 min eager vs seconds jitted for ResNet-50).
+    """
+    variables = jax.jit(model.init)(rng, jnp.zeros(example_image_shape, jnp.float32))
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=tx.init(params),
+        tx=tx,
+    )

@@ -1,0 +1,183 @@
+"""The SPMD train step: ONE jit-compiled program per shape bucket.
+
+This is the TPU-native replacement for the reference's entire per-step stack
+(SURVEY.md call stack 3.4): Keras ``train_function`` forward/backward +
+``hvd.DistributedOptimizer``'s per-tensor NCCL ring allreduce.  Here the
+whole thing — forward, on-device target assignment, losses, backward,
+``lax.pmean`` gradient allreduce over the ``data`` mesh axis, and the
+optimizer update — is one XLA program built with ``shard_map``; XLA compiles
+the pmean into ICI collectives and overlaps them with backward compute (the
+compile-time analogue of Horovod's tensor-fusion buffer, SURVEY.md H2).
+
+Anchors enter as a compile-time constant (ops/anchors.py), and target
+assignment (IoU + argmax matching) runs on device under ``stop_gradient``,
+per the north star (BASELINE.json:5).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from batchai_retinanet_horovod_coco_tpu import losses as losses_lib
+from batchai_retinanet_horovod_coco_tpu.ops import anchors as anchors_lib
+from batchai_retinanet_horovod_coco_tpu.ops import matching as matching_lib
+from batchai_retinanet_horovod_coco_tpu.parallel.mesh import DATA_AXIS
+from batchai_retinanet_horovod_coco_tpu.train.state import TrainState
+
+
+def _forward_and_loss(
+    model,
+    state: TrainState,
+    params,
+    images: jnp.ndarray,
+    gt_boxes: jnp.ndarray,
+    gt_labels: jnp.ndarray,
+    gt_mask: jnp.ndarray,
+    anchors: jnp.ndarray,
+    num_classes: int,
+    loss_config: losses_lib.LossConfig,
+    matching_config: matching_lib.MatchingConfig,
+    train: bool,
+):
+    variables = {"params": params}
+    has_bn = bool(state.batch_stats)
+    if has_bn:
+        variables["batch_stats"] = state.batch_stats
+
+    if has_bn and train:
+        outputs, mutated = model.apply(
+            variables, images, train=True, mutable=["batch_stats"]
+        )
+        new_batch_stats = mutated["batch_stats"]
+    else:
+        outputs = model.apply(variables, images, train=train)
+        new_batch_stats = state.batch_stats
+
+    # On-device target assignment; no gradients flow into the matching.
+    targets = jax.vmap(
+        matching_lib.anchor_targets, in_axes=(None, 0, 0, 0, None, None)
+    )(anchors, gt_boxes, gt_labels, gt_mask, num_classes, matching_config)
+    targets = jax.tree.map(lax.stop_gradient, targets)
+
+    metrics = losses_lib.total_loss(
+        outputs["cls_logits"],
+        outputs["box_deltas"],
+        targets.cls_targets,
+        targets.box_targets,
+        targets.state,
+        loss_config,
+    )
+    metrics["num_pos"] = jnp.sum(
+        (targets.state == matching_lib.POSITIVE).astype(jnp.float32)
+    )
+    return metrics["loss"], (metrics, new_batch_stats)
+
+
+def make_train_step(
+    model,
+    image_hw: tuple[int, int],
+    num_classes: int,
+    mesh: Mesh | None = None,
+    loss_config: losses_lib.LossConfig = losses_lib.LossConfig(),
+    matching_config: matching_lib.MatchingConfig = matching_lib.MatchingConfig(),
+    anchor_config: anchors_lib.AnchorConfig | None = None,
+    donate_state: bool = True,
+) -> Callable[[TrainState, dict[str, Any]], tuple[TrainState, dict[str, jnp.ndarray]]]:
+    """Build the jitted train step for one shape bucket.
+
+    With ``mesh``: the step is a ``shard_map`` over the mesh — the batch is
+    consumed shard-by-shard (each device sees batch/n_devices images),
+    gradients and metrics are ``lax.pmean``-ed over the ``data`` axis, and
+    every device applies the identical update to its replicated state.
+
+    Without ``mesh``: plain single-device jit (BASELINE.json configs[1]).
+
+    The returned callable takes (state, batch_dict) where batch_dict holds
+    ``images, gt_boxes, gt_labels, gt_mask`` (leading axis = GLOBAL batch)
+    and returns (new_state, metrics).
+    """
+    anchors = jnp.asarray(
+        anchors_lib.anchors_for_image_shape(image_hw, anchor_config or anchors_lib.AnchorConfig())
+    )
+
+    def local_step(state: TrainState, batch: dict[str, Any]):
+        (_, (metrics, new_bs)), grads = jax.value_and_grad(
+            lambda p: _forward_and_loss(
+                model, state, p,
+                batch["images"], batch["gt_boxes"], batch["gt_labels"],
+                batch["gt_mask"], anchors, num_classes, loss_config,
+                matching_config, train=True,
+            ),
+            has_aux=True,
+        )(state.params)
+        return grads, metrics, new_bs
+
+    if mesh is None:
+
+        @partial(jax.jit, donate_argnums=(0,) if donate_state else ())
+        def train_step(state: TrainState, batch: dict[str, Any]):
+            grads, metrics, new_bs = local_step(state, batch)
+            new_state = state.apply_gradients(grads, new_bs)
+            return new_state, metrics
+
+        return train_step
+
+    batch_spec = {k: P(DATA_AXIS) for k in ("images", "gt_boxes", "gt_labels", "gt_mask")}
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), batch_spec),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    def sharded_step(state: TrainState, batch: dict[str, Any]):
+        grads, metrics, new_bs = local_step(state, batch)
+        # THE allreduce: Horovod's NCCL ring → one compiled pmean over ICI.
+        grads = lax.pmean(grads, DATA_AXIS)
+        metrics = lax.pmean(metrics, DATA_AXIS)
+        if state.batch_stats:
+            new_bs = lax.pmean(new_bs, DATA_AXIS)  # sync-BN semantics
+        new_state = state.apply_gradients(grads, new_bs)
+        return new_state, metrics
+
+    return jax.jit(sharded_step, donate_argnums=(0,) if donate_state else ())
+
+
+def make_eval_forward(
+    model,
+    mesh: Mesh | None = None,
+) -> Callable[[TrainState, jnp.ndarray], dict[str, jnp.ndarray]]:
+    """Jitted inference forward: images → {cls_logits, box_deltas}.
+
+    Uses running/frozen statistics (train=False).  With a mesh, the batch is
+    sharded over ``data`` and outputs gathered — XLA inserts the all_gather
+    (the reference ran eval on rank 0 only, SURVEY.md M10; here every chip
+    contributes).
+    """
+
+    def forward(state: TrainState, images: jnp.ndarray):
+        variables = {"params": state.params}
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+        return model.apply(variables, images, train=False)
+
+    if mesh is None:
+        return jax.jit(forward)
+
+    sharded = shard_map(
+        forward,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS)),
+        out_specs=P(DATA_AXIS),
+        check_vma=False,
+    )
+    return jax.jit(sharded)

@@ -63,3 +63,11 @@ def test_anchor_cache_identity():
     a = anchors_for_image_shape((256, 256))
     b = anchors_for_image_shape((256, 256))
     assert a is b  # lru_cache returns the same array: free at step time
+
+
+def test_cached_anchors_are_readonly():
+    a = anchors_for_image_shape((128, 128))
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        a[0, 0] = 5.0

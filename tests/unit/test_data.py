@@ -1,0 +1,138 @@
+import numpy as np
+import pytest
+
+from batchai_retinanet_horovod_coco_tpu.data import (
+    CocoDataset,
+    PipelineConfig,
+    build_pipeline,
+    make_synthetic_coco,
+)
+from batchai_retinanet_horovod_coco_tpu.data.pipeline import (
+    pick_bucket,
+    resize_scale,
+)
+
+
+@pytest.fixture(scope="module")
+def synthetic_dataset(tmp_path_factory):
+    root = str(tmp_path_factory.mktemp("coco"))
+    ann = make_synthetic_coco(root, num_images=10, num_classes=3, seed=1)
+    return CocoDataset(ann, image_dir=f"{root}/train")
+
+
+def test_dataset_parsing(synthetic_dataset):
+    ds = synthetic_dataset
+    assert ds.num_classes == 3
+    assert len(ds) == 10
+    rec = ds.records[0]
+    assert rec.boxes.shape[1] == 4
+    # Corner boxes inside the image.
+    assert np.all(rec.boxes[:, 2] > rec.boxes[:, 0])
+    assert np.all(rec.boxes[:, 2] <= rec.width)
+    # Contiguous labels.
+    assert rec.labels.min() >= 0 and rec.labels.max() < 3
+
+
+def test_category_id_mapping(synthetic_dataset):
+    ds = synthetic_dataset
+    # COCO ids 1..3 → labels 0..2, sorted by id.
+    assert ds.cat_id_to_label == {1: 0, 2: 1, 3: 2}
+    assert ds.label_to_cat_id[0] == 1
+
+
+def test_resize_scale_reference_rule():
+    # min side → 800 unless max side would exceed 1333.
+    assert resize_scale(600, 600, 800, 1333) == pytest.approx(800 / 600)
+    # 480x640: scale by 800/480 would give max 1066 < 1333 → min-side rule.
+    assert resize_scale(480, 640, 800, 1333) == pytest.approx(800 / 480)
+    # 400x1200: min-side rule gives 2.0 → max 2400 > 1333 → cap at 1333/1200.
+    assert resize_scale(400, 1200, 800, 1333) == pytest.approx(1333 / 1200)
+
+
+def test_pick_bucket():
+    buckets = ((800, 1344), (1344, 800), (1024, 1024))
+    assert pick_bucket(800, 1066, buckets) == (800, 1344)
+    assert pick_bucket(1066, 800, buckets) == (1344, 800)
+    assert pick_bucket(1000, 1000, buckets) == (1024, 1024)
+    # Nothing fits → largest bucket.
+    assert pick_bucket(2000, 2000, buckets) in buckets
+
+
+def test_train_pipeline_shapes(synthetic_dataset):
+    cfg = PipelineConfig(
+        batch_size=2,
+        buckets=((320, 320),),
+        min_side=300,
+        max_side=320,
+        max_gt=8,
+        num_workers=2,
+        prefetch=1,
+        seed=0,
+    )
+    it = build_pipeline(synthetic_dataset, cfg, train=True)
+    batch = next(it)
+    assert batch.images.shape == (2, 320, 320, 3)
+    assert batch.gt_boxes.shape == (2, 8, 4)
+    assert batch.gt_mask.dtype == bool
+    assert batch.gt_mask.any()
+    # Boxes are in resized coords, inside the bucket.
+    valid_boxes = batch.gt_boxes[batch.gt_mask]
+    assert np.all(valid_boxes[:, 2] <= 320 + 1e-3)
+    # Normalized images: roughly zero-centered.
+    assert abs(float(batch.images.mean())) < 2.0
+
+
+def test_eval_pipeline_covers_all_records_once(synthetic_dataset):
+    cfg = PipelineConfig(
+        batch_size=4,
+        buckets=((320, 320),),
+        min_side=300,
+        max_side=320,
+        max_gt=8,
+        hflip_prob=0.0,
+        num_workers=2,
+        drop_remainder=False,
+    )
+    it = build_pipeline(synthetic_dataset, cfg, train=False)
+    seen = []
+    for batch in it:
+        assert batch.images.shape[0] == 4  # padded to full batch
+        seen.extend(batch.image_ids[batch.valid].tolist())
+    assert sorted(seen) == sorted(r.image_id for r in synthetic_dataset.records)
+
+
+def test_sharding_partitions_records(synthetic_dataset):
+    ids = []
+    for shard in range(2):
+        cfg = PipelineConfig(
+            batch_size=1,
+            buckets=((320, 320),),
+            min_side=300,
+            max_side=320,
+            max_gt=8,
+            hflip_prob=0.0,
+            shard_index=shard,
+            shard_count=2,
+            num_workers=1,
+            drop_remainder=False,
+        )
+        for batch in build_pipeline(synthetic_dataset, cfg, train=False):
+            ids.extend(batch.image_ids[batch.valid].tolist())
+    assert sorted(ids) == sorted(r.image_id for r in synthetic_dataset.records)
+
+
+def test_determinism_same_seed(synthetic_dataset):
+    cfg = PipelineConfig(
+        batch_size=2,
+        buckets=((320, 320),),
+        min_side=300,
+        max_side=320,
+        max_gt=8,
+        num_workers=2,
+        seed=7,
+    )
+    a = next(build_pipeline(synthetic_dataset, cfg, train=True))
+    b = next(build_pipeline(synthetic_dataset, cfg, train=True))
+    np.testing.assert_array_equal(a.image_ids, b.image_ids)
+    np.testing.assert_allclose(a.images, b.images)
+    np.testing.assert_allclose(a.gt_boxes, b.gt_boxes)

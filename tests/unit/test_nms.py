@@ -88,3 +88,16 @@ def test_multiclass_fixed_output_shape():
     assert det.scores.shape == (25,)
     assert det.labels.shape == (25,)
     assert not np.any(np.asarray(det.valid))
+
+
+def test_batched_nms_accepts_kwargs():
+    from batchai_retinanet_horovod_coco_tpu.ops.nms import batched_multiclass_nms
+
+    boxes = np.zeros((2, 10, 4), dtype=np.float32)
+    boxes[:, :, 2:] = 10.0
+    scores = np.full((2, 10, 3), 0.2, dtype=np.float32)
+    det = batched_multiclass_nms(
+        boxes, scores, score_threshold=0.3, max_detections=5
+    )
+    assert det.boxes.shape == (2, 5, 4)
+    assert not np.any(np.asarray(det.valid))  # all below threshold 0.3

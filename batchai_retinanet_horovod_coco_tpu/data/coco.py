@@ -6,8 +6,12 @@ contiguous labels 0..K-1 (sorted by category id, the pycocotools convention),
 and expose per-image boxes/labels.  Boxes are converted from COCO ``[x, y, w,
 h]`` to corner ``[x1, y1, x2, y2]`` once at load time.
 
-Crowd annotations (``iscrowd=1``) are dropped for training, matching the
-reference generator's default behavior.
+Crowd annotations (``iscrowd=1``) are excluded from training boxes — matching
+the reference generator's default — but are kept on the record separately so
+evaluation can mark them ignore, exactly as pycocotools' COCOeval does
+(detections matching a crowd region are neither TP nor FP).  Per-annotation
+``area`` (segmentation area on real COCO) is preserved for COCOeval's
+area-range bucketing, which uses it rather than the bbox area.
 """
 
 from __future__ import annotations
@@ -25,8 +29,12 @@ class ImageRecord:
     file_name: str
     width: int
     height: int
-    boxes: np.ndarray  # (N, 4) float32 corner boxes
+    boxes: np.ndarray  # (N, 4) float32 corner boxes (non-crowd)
     labels: np.ndarray  # (N,) int32 contiguous labels
+    areas: np.ndarray  # (N,) float32 annotation areas (COCOeval bucketing)
+    crowd_boxes: np.ndarray  # (C, 4) float32 corner boxes (iscrowd=1)
+    crowd_labels: np.ndarray  # (C,) int32
+    crowd_areas: np.ndarray  # (C,) float32
 
 
 class CocoDataset:
@@ -36,7 +44,6 @@ class CocoDataset:
         self,
         annotation_file: str,
         image_dir: str | None = None,
-        include_crowd: bool = False,
         keep_empty: bool = False,
     ):
         with open(annotation_file) as f:
@@ -50,20 +57,14 @@ class CocoDataset:
 
         per_image: dict[int, list[dict]] = {}
         for ann in blob.get("annotations", []):
-            if not include_crowd and ann.get("iscrowd", 0):
-                continue
             per_image.setdefault(ann["image_id"], []).append(ann)
 
         self.records: list[ImageRecord] = []
         for img in blob.get("images", []):
             anns = per_image.get(img["id"], [])
-            boxes = np.zeros((len(anns), 4), dtype=np.float32)
-            labels = np.zeros((len(anns),), dtype=np.int32)
-            for i, ann in enumerate(anns):
-                x, y, w, h = ann["bbox"]
-                boxes[i] = [x, y, x + w, y + h]
-                labels[i] = self.cat_id_to_label[ann["category_id"]]
-            if len(anns) == 0 and not keep_empty:
+            normal = [a for a in anns if not a.get("iscrowd", 0)]
+            crowd = [a for a in anns if a.get("iscrowd", 0)]
+            if not normal and not keep_empty:
                 continue
             self.records.append(
                 ImageRecord(
@@ -71,10 +72,25 @@ class CocoDataset:
                     file_name=img["file_name"],
                     width=img["width"],
                     height=img["height"],
-                    boxes=boxes,
-                    labels=labels,
+                    **self._pack(normal, prefix=""),
+                    **self._pack(crowd, prefix="crowd_"),
                 )
             )
+
+    def _pack(self, anns: list[dict], prefix: str) -> dict[str, np.ndarray]:
+        boxes = np.zeros((len(anns), 4), dtype=np.float32)
+        labels = np.zeros((len(anns),), dtype=np.int32)
+        areas = np.zeros((len(anns),), dtype=np.float32)
+        for i, ann in enumerate(anns):
+            x, y, w, h = ann["bbox"]
+            boxes[i] = [x, y, x + w, y + h]
+            labels[i] = self.cat_id_to_label[ann["category_id"]]
+            areas[i] = ann.get("area", w * h)
+        return {
+            f"{prefix}boxes": boxes,
+            f"{prefix}labels": labels,
+            f"{prefix}areas": areas,
+        }
 
     @property
     def num_classes(self) -> int:

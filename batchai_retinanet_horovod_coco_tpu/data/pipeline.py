@@ -94,11 +94,14 @@ def load_example(
     record: ImageRecord,
     config: PipelineConfig,
     rng: np.random.Generator | None,
-) -> tuple[np.ndarray, np.ndarray, np.ndarray, float, tuple[int, int]]:
-    """Decode + (train-only) flip + resize one image.
+    bucket: tuple[int, int],
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, float]:
+    """Decode + (train-only) flip + resize one image into ``bucket``.
 
-    Returns (image f32 HWC normalized, boxes (N,4) resized, labels, scale,
-    bucket_hw).  The image is NOT yet padded to the bucket.
+    Returns (image f32 HWC normalized, boxes (N,4) resized, labels, scale).
+    The image is NOT yet padded to the bucket, but is guaranteed to fit it:
+    when no bucket fits the reference resize rule (extreme aspect ratios),
+    the scale is capped so the image fits the one the producer chose.
     """
     from PIL import Image
 
@@ -114,20 +117,21 @@ def load_example(
         boxes[:, 0] = w - boxes[:, 2]
         boxes[:, 2] = w - x1
 
-    scale = resize_scale(h, w, config.min_side, config.max_side)
-    nh, nw = int(round(h * scale)), int(round(w * scale))
+    bh, bw = bucket
+    scale = min(resize_scale(h, w, config.min_side, config.max_side), bh / h, bw / w)
+    nh = min(bh, int(round(h * scale)))
+    nw = min(bw, int(round(w * scale)))
     if (nh, nw) != (h, w):
         image = np.asarray(
             Image.fromarray(image).resize((nw, nh), Image.BILINEAR), dtype=np.uint8
         )
         boxes = boxes * scale
-    bucket = pick_bucket(nh, nw, config.buckets)
     normalized = (image.astype(np.float32) / 255.0 - IMAGENET_MEAN) / IMAGENET_STD
-    return normalized, boxes, labels, scale, bucket
+    return normalized, boxes, labels, scale
 
 
 def _assemble(
-    examples: list[tuple[np.ndarray, np.ndarray, np.ndarray, float, tuple[int, int]]],
+    examples: list[tuple[np.ndarray, np.ndarray, np.ndarray, float]],
     image_ids: list[int],
     bucket: tuple[int, int],
     config: PipelineConfig,
@@ -139,7 +143,7 @@ def _assemble(
     gt_labels = np.zeros((b, config.max_gt), dtype=np.int32)
     gt_mask = np.zeros((b, config.max_gt), dtype=bool)
     scales = np.zeros((b,), dtype=np.float32)
-    for i, (img, boxes, labels, scale, _) in enumerate(examples):
+    for i, (img, boxes, labels, scale) in enumerate(examples):
         h, w = img.shape[:2]
         images[i, :h, :w] = img
         n = min(len(boxes), config.max_gt)
@@ -197,12 +201,22 @@ def build_pipeline(
     stop = threading.Event()
     _SENTINEL = object()
 
+    def _put(item) -> bool:
+        """Blocking put that aborts when the consumer is gone (no thread leak)."""
+        while not stop.is_set():
+            try:
+                out.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     def producer() -> None:
         pool = ThreadPoolExecutor(max_workers=config.num_workers)
         try:
             _produce(pool)
         except BaseException as exc:  # propagate to the consumer; never hang
-            out.put(exc)
+            _put(exc)
         finally:
             pool.shutdown(wait=False)
 
@@ -229,6 +243,7 @@ def build_pipeline(
                                 dataset.records[i],
                                 config,
                                 example_rng(epoch, int(i)),
+                                bucket,
                             )
                             for i in chunk
                         ]
@@ -237,11 +252,10 @@ def build_pipeline(
                         batch = _assemble(examples, ids, bucket, config)
                         if not train and len(chunk) < config.batch_size:
                             batch = _pad_batch(batch, config.batch_size)
-                        if stop.is_set():
+                        if not _put(batch):
                             return
-                        out.put(batch)
                 if not train:
-                    out.put(_SENTINEL)
+                    _put(_SENTINEL)
                     return
                 epoch += 1
 

@@ -1,0 +1,34 @@
+"""Evaluation: on-device detection + host-side COCO mAP oracle.
+
+Replaces the reference's eval layer (SURVEY.md M3/M6/M10, call stack 3.5):
+the inference "bbox model" + FilterDetections become one jitted device
+function (detect.py), and pycocotools' C-backed COCOeval becomes a numpy
+oracle with identical bbox semantics (coco_eval.py) since this environment
+has no pycocotools.
+"""
+
+from batchai_retinanet_horovod_coco_tpu.evaluate.coco_eval import (
+    CocoEval,
+    EvalParams,
+    evaluate_detections,
+)
+from batchai_retinanet_horovod_coco_tpu.evaluate.detect import (
+    DetectConfig,
+    collect_detections,
+    coco_gt_from_dataset,
+    detections_to_coco,
+    make_detect_fn,
+    run_coco_eval,
+)
+
+__all__ = [
+    "CocoEval",
+    "DetectConfig",
+    "EvalParams",
+    "coco_gt_from_dataset",
+    "collect_detections",
+    "detections_to_coco",
+    "evaluate_detections",
+    "make_detect_fn",
+    "run_coco_eval",
+]

@@ -1,0 +1,339 @@
+"""COCO detection-mAP oracle: pycocotools ``COCOeval`` bbox semantics in numpy.
+
+This environment has no pycocotools (SURVEY.md §7), so the evaluation metric
+— the north-star number itself (BASELINE.json: "COCO mAP@[.5:.95] parity") —
+is reimplemented here from the published COCOeval contract (SURVEY.md §7.3
+hard part 4; API shape preserved locally at ``pycocotools/cocoeval.pyi``):
+
+- IoU thresholds 0.50:0.05:0.95 (10), recall thresholds 0:0.01:1 (101-point
+  interpolated AP), maxDets [1, 10, 100];
+- area ranges all/small/medium/large = [0,1e10]/[0,32²]/[32²,96²]/[96²,1e10];
+- greedy per-image per-category matching in descending score order, each
+  detection taking the best still-unmatched gt with IoU ≥ threshold,
+  crowd/out-of-range gts matchable but marked ignore;
+- monotone precision envelope + searchsorted sampling at the 101 recall
+  points; AP = mean over classes and IoU thresholds of sampled precision.
+
+The class mirrors COCOeval's evaluate/accumulate/summarize triple so results
+are comparable line-by-line with reference logs (SURVEY.md call stack 3.5).
+Inputs are plain lists of dicts in COCO annotation/result format, decoupled
+from any dataset class.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class EvalParams:
+    """Mirror of pycocotools ``Params(iouType='bbox')`` defaults."""
+
+    iou_thrs: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.linspace(0.5, 0.95, 10)
+    )
+    rec_thrs: np.ndarray = dataclasses.field(
+        default_factory=lambda: np.linspace(0.0, 1.0, 101)
+    )
+    max_dets: tuple[int, ...] = (1, 10, 100)
+    area_rng: tuple[tuple[float, float], ...] = (
+        (0.0, 1e10),
+        (0.0, 32.0**2),
+        (32.0**2, 96.0**2),
+        (96.0**2, 1e10),
+    )
+    area_rng_lbl: tuple[str, ...] = ("all", "small", "medium", "large")
+
+
+def bbox_iou_xywh(dt: np.ndarray, gt: np.ndarray, iscrowd: np.ndarray) -> np.ndarray:
+    """Pairwise IoU of xywh boxes, crowd-aware (COCO ``maskUtils.iou`` bbox path).
+
+    For a crowd gt the denominator is the detection area alone (a detection
+    inside a crowd region counts as fully covered).
+    Shapes: dt (D, 4), gt (G, 4) → (D, G).
+    """
+    if len(dt) == 0 or len(gt) == 0:
+        return np.zeros((len(dt), len(gt)), dtype=np.float64)
+    dx1, dy1 = dt[:, 0], dt[:, 1]
+    dx2, dy2 = dt[:, 0] + dt[:, 2], dt[:, 1] + dt[:, 3]
+    gx1, gy1 = gt[:, 0], gt[:, 1]
+    gx2, gy2 = gt[:, 0] + gt[:, 2], gt[:, 1] + gt[:, 3]
+    iw = np.clip(
+        np.minimum(dx2[:, None], gx2[None, :]) - np.maximum(dx1[:, None], gx1[None, :]),
+        0.0,
+        None,
+    )
+    ih = np.clip(
+        np.minimum(dy2[:, None], gy2[None, :]) - np.maximum(dy1[:, None], gy1[None, :]),
+        0.0,
+        None,
+    )
+    inter = iw * ih
+    d_area = (dt[:, 2] * dt[:, 3])[:, None]
+    g_area = (gt[:, 2] * gt[:, 3])[None, :]
+    union = np.where(iscrowd[None, :].astype(bool), d_area, d_area + g_area - inter)
+    return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
+
+
+class CocoEval:
+    """bbox-only COCOeval: ``evaluate() → accumulate() → summarize()``.
+
+    ``gt_anns``: COCO annotation dicts (image_id, category_id, bbox xywh,
+    area, iscrowd, optional ignore).  ``dt_anns``: COCO result dicts
+    (image_id, category_id, bbox xywh, score).  ``img_ids`` fixes the
+    evaluated image set (images with no gt still contribute false positives,
+    as in pycocotools when the gt index knows the image).
+    """
+
+    def __init__(
+        self,
+        gt_anns: list[dict],
+        dt_anns: list[dict],
+        img_ids: list[int] | None = None,
+        params: EvalParams | None = None,
+    ):
+        self.params = params or EvalParams()
+        if img_ids is None:
+            img_ids = sorted(
+                {a["image_id"] for a in gt_anns} | {a["image_id"] for a in dt_anns}
+            )
+        self.img_ids = list(img_ids)
+        self.cat_ids = sorted(
+            {a["category_id"] for a in gt_anns} | {a["category_id"] for a in dt_anns}
+        )
+
+        self._gts: dict[tuple[int, int], list[dict]] = {}
+        self._dts: dict[tuple[int, int], list[dict]] = {}
+        img_set = set(self.img_ids)
+        for a in gt_anns:
+            if a["image_id"] in img_set:
+                self._gts.setdefault((a["image_id"], a["category_id"]), []).append(a)
+        for a in dt_anns:
+            if a["image_id"] in img_set:
+                self._dts.setdefault((a["image_id"], a["category_id"]), []).append(a)
+
+        self.eval_imgs: dict[tuple[int, int, int], dict | None] = {}
+        self._prepared: dict[tuple[int, int], tuple | None] = {}
+        self.eval: dict = {}
+        self.stats = np.zeros(12)
+
+    # -- evaluate ----------------------------------------------------------
+
+    def _prepare(self, img_id: int, cat_id: int, max_det: int) -> tuple | None:
+        """Score-sort dets and compute the IoU matrix ONCE per (img, cat).
+
+        The result is shared by all four area ranges (pycocotools'
+        ``computeIoU`` cache); ious are in (score-sorted det) × (original gt)
+        order.
+        """
+        key = (img_id, cat_id)
+        if key in self._prepared:
+            return self._prepared[key]
+        gt = self._gts.get(key, [])
+        dt = self._dts.get(key, [])
+        if not gt and not dt:
+            self._prepared[key] = None
+            return None
+        d_scores = np.array([d["score"] for d in dt], dtype=np.float64)
+        d_order = np.argsort(-d_scores, kind="stable")[:max_det]
+        dt = [dt[i] for i in d_order]
+        g_boxes = np.array([g["bbox"] for g in gt], dtype=np.float64).reshape(-1, 4)
+        d_boxes = np.array([d["bbox"] for d in dt], dtype=np.float64).reshape(-1, 4)
+        g_crowd = np.array([bool(g.get("iscrowd", 0)) for g in gt], dtype=bool)
+        ious = bbox_iou_xywh(d_boxes, g_boxes, g_crowd)
+        prepared = (gt, dt, d_boxes, ious)
+        self._prepared[key] = prepared
+        return prepared
+
+    def _evaluate_img(
+        self, img_id: int, cat_id: int, area_rng: tuple[float, float], max_det: int
+    ) -> dict | None:
+        p = self.params
+        prepared = self._prepare(img_id, cat_id, max_det)
+        if prepared is None:
+            return None
+        gt, dt, d_boxes, ious_raw = prepared
+
+        g_ignore = np.array(
+            [
+                bool(g.get("ignore", 0))
+                or bool(g.get("iscrowd", 0))
+                or g["area"] < area_rng[0]
+                or g["area"] > area_rng[1]
+                for g in gt
+            ],
+            dtype=bool,
+        )
+        # Non-ignored gts first (stable), matching pycocotools' argsort.
+        g_order = np.argsort(g_ignore, kind="stable")
+        gt = [gt[i] for i in g_order]
+        g_ignore = g_ignore[g_order]
+        g_crowd = np.array([bool(g.get("iscrowd", 0)) for g in gt], dtype=bool)
+        ious = ious_raw[:, g_order] if len(gt) else ious_raw
+
+        T = len(p.iou_thrs)
+        D, G = len(dt), len(gt)
+        gtm = -np.ones((T, G), dtype=np.int64)  # index of matching det
+        dtm = -np.ones((T, D), dtype=np.int64)  # index of matching gt
+        dt_ignore = np.zeros((T, D), dtype=bool)
+
+        for t, thr in enumerate(p.iou_thrs):
+            for dind in range(D):
+                best = min(thr, 1.0 - 1e-10)
+                m = -1
+                for gind in range(G):
+                    # Gt already claimed at this threshold (crowds may rematch).
+                    if gtm[t, gind] >= 0 and not g_crowd[gind]:
+                        continue
+                    # Gts are sorted ignore-last: once we have a real match,
+                    # stop before the ignore region.
+                    if m > -1 and not g_ignore[m] and g_ignore[gind]:
+                        break
+                    if ious[dind, gind] < best:
+                        continue
+                    best = ious[dind, gind]
+                    m = gind
+                if m == -1:
+                    continue
+                dtm[t, dind] = m
+                gtm[t, m] = dind
+                dt_ignore[t, dind] = g_ignore[m]
+
+        # Unmatched dets whose own area is outside the range are ignored too.
+        d_area = d_boxes[:, 2] * d_boxes[:, 3]
+        d_out = (d_area < area_rng[0]) | (d_area > area_rng[1])
+        dt_ignore |= (dtm == -1) & d_out[None, :]
+
+        return {
+            "dt_scores": np.array([d["score"] for d in dt], dtype=np.float64),
+            "dt_matched": dtm >= 0,
+            "dt_ignore": dt_ignore,
+            "num_gt": int((~g_ignore).sum()),
+        }
+
+    def evaluate(self) -> None:
+        p = self.params
+        max_det = p.max_dets[-1]
+        for c, cat_id in enumerate(self.cat_ids):
+            for a, area_rng in enumerate(p.area_rng):
+                for img_id in self.img_ids:
+                    self.eval_imgs[(c, a, img_id)] = self._evaluate_img(
+                        img_id, cat_id, area_rng, max_det
+                    )
+
+    # -- accumulate --------------------------------------------------------
+
+    def accumulate(self) -> None:
+        p = self.params
+        T, R = len(p.iou_thrs), len(p.rec_thrs)
+        K, A, M = len(self.cat_ids), len(p.area_rng), len(p.max_dets)
+        precision = -np.ones((T, R, K, A, M))
+        recall = -np.ones((T, K, A, M))
+
+        for k in range(K):
+            for a in range(A):
+                imgs = [
+                    e
+                    for img_id in self.img_ids
+                    if (e := self.eval_imgs.get((k, a, img_id))) is not None
+                ]
+                if not imgs:
+                    continue
+                for m, max_det in enumerate(p.max_dets):
+                    scores = np.concatenate([e["dt_scores"][:max_det] for e in imgs])
+                    # Stable global sort by descending score (mergesort, as
+                    # in pycocotools, keeps cross-refactor determinism).
+                    order = np.argsort(-scores, kind="mergesort")
+                    matched = np.concatenate(
+                        [e["dt_matched"][:, :max_det] for e in imgs], axis=1
+                    )[:, order]
+                    ignored = np.concatenate(
+                        [e["dt_ignore"][:, :max_det] for e in imgs], axis=1
+                    )[:, order]
+                    npig = sum(e["num_gt"] for e in imgs)
+                    if npig == 0:
+                        continue
+                    tps = np.cumsum(matched & ~ignored, axis=1, dtype=np.float64)
+                    fps = np.cumsum(~matched & ~ignored, axis=1, dtype=np.float64)
+                    for t in range(T):
+                        tp, fp = tps[t], fps[t]
+                        nd = len(tp)
+                        rc = tp / npig
+                        pr = tp / np.maximum(tp + fp, np.spacing(1))
+                        recall[t, k, a, m] = rc[-1] if nd else 0.0
+                        # Monotone envelope: precision at recall r is the max
+                        # precision at any recall ≥ r.
+                        pr = np.maximum.accumulate(pr[::-1])[::-1]
+                        inds = np.searchsorted(rc, p.rec_thrs, side="left")
+                        q = np.zeros(R)
+                        valid = inds < nd
+                        q[valid] = pr[inds[valid]]
+                        precision[t, :, k, a, m] = q
+
+        self.eval = {"precision": precision, "recall": recall}
+
+    # -- summarize ---------------------------------------------------------
+
+    def _summarize(
+        self,
+        ap: bool,
+        iou_thr: float | None = None,
+        area: str = "all",
+        max_dets: int = 100,
+    ) -> float:
+        p = self.params
+        a = p.area_rng_lbl.index(area)
+        m = p.max_dets.index(max_dets)
+        if ap:
+            s = self.eval["precision"]
+            if iou_thr is not None:
+                s = s[np.where(np.isclose(p.iou_thrs, iou_thr))[0]]
+            s = s[:, :, :, a, m]
+        else:
+            s = self.eval["recall"]
+            if iou_thr is not None:
+                s = s[np.where(np.isclose(p.iou_thrs, iou_thr))[0]]
+            s = s[:, :, a, m]
+        valid = s[s > -1]
+        return float(valid.mean()) if valid.size else -1.0
+
+    def summarize(self) -> np.ndarray:
+        """The 12 standard COCO stats; stats[0] is mAP@[.5:.95]."""
+        self.stats = np.array(
+            [
+                self._summarize(True),
+                self._summarize(True, iou_thr=0.5),
+                self._summarize(True, iou_thr=0.75),
+                self._summarize(True, area="small"),
+                self._summarize(True, area="medium"),
+                self._summarize(True, area="large"),
+                self._summarize(False, max_dets=1),
+                self._summarize(False, max_dets=10),
+                self._summarize(False, max_dets=100),
+                self._summarize(False, area="small"),
+                self._summarize(False, area="medium"),
+                self._summarize(False, area="large"),
+            ]
+        )
+        return self.stats
+
+
+_STAT_NAMES = (
+    "AP", "AP50", "AP75", "APsmall", "APmedium", "APlarge",
+    "AR1", "AR10", "AR100", "ARsmall", "ARmedium", "ARlarge",
+)
+
+
+def evaluate_detections(
+    gt_anns: list[dict],
+    dt_anns: list[dict],
+    img_ids: list[int] | None = None,
+) -> dict[str, float]:
+    """One-call evaluate/accumulate/summarize → named stats dict."""
+    ev = CocoEval(gt_anns, dt_anns, img_ids=img_ids)
+    ev.evaluate()
+    ev.accumulate()
+    stats = ev.summarize()
+    return dict(zip(_STAT_NAMES, (float(s) for s in stats)))

@@ -1,0 +1,206 @@
+"""On-device detection: forward → decode → clip → batched NMS, one XLA program.
+
+Replaces the reference's separate "inference model" conversion step and its
+``Anchors → RegressBoxes → ClipBoxes → FilterDetections`` layer stack
+(SURVEY.md M3/M6, call stack 3.5, ``bin/convert_model.py``): here inference
+is just another jitted function over the same train-state params, with the
+whole post-processing (sigmoid, top-k pre-select, class-offset NMS) running
+on the TPU per BASELINE.json configs[4] ("on-device batched NMS").
+
+``run_coco_eval`` is the dataset-level driver (the ``CocoEval`` callback /
+``evaluate_coco()`` equivalent, SURVEY.md M10): stream the eval pipeline,
+detect per static shape bucket (one compiled program each), rescale boxes to
+original image coordinates on host, and hand COCO-format results to the
+numpy mAP oracle (evaluate/coco_eval.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Iterable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from batchai_retinanet_horovod_coco_tpu.data.coco import CocoDataset
+from batchai_retinanet_horovod_coco_tpu.data.pipeline import Batch
+from batchai_retinanet_horovod_coco_tpu.evaluate.coco_eval import evaluate_detections
+from batchai_retinanet_horovod_coco_tpu.ops import anchors as anchors_lib
+from batchai_retinanet_horovod_coco_tpu.ops import boxes as boxes_lib
+from batchai_retinanet_horovod_coco_tpu.ops import nms as nms_lib
+from batchai_retinanet_horovod_coco_tpu.parallel.mesh import DATA_AXIS
+
+
+@dataclasses.dataclass(frozen=True)
+class DetectConfig:
+    """FilterDetections-equivalent knobs (reference defaults, SURVEY.md M6)."""
+
+    score_threshold: float = 0.05
+    iou_threshold: float = 0.5
+    pre_nms_size: int = 1000
+    max_detections: int = 300
+    codec: boxes_lib.BoxCodecConfig = boxes_lib.BoxCodecConfig()
+    anchor: anchors_lib.AnchorConfig = anchors_lib.AnchorConfig()
+
+
+def make_detect_fn(
+    model,
+    image_hw: tuple[int, int],
+    config: DetectConfig = DetectConfig(),
+    mesh: Mesh | None = None,
+) -> Callable[[Any, jnp.ndarray], nms_lib.Detections]:
+    """Jitted (state, images (B,H,W,3)) → batched Detections for one bucket.
+
+    With ``mesh``, the batch shards over the ``data`` axis and results gather
+    back — eval uses every chip instead of the reference's rank-0-only path.
+    """
+    anchors = jnp.asarray(
+        anchors_lib.anchors_for_image_shape(image_hw, config.anchor)
+    )
+
+    def detect(state, images: jnp.ndarray) -> nms_lib.Detections:
+        variables = {"params": state.params}
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+        outputs = model.apply(variables, images, train=False)
+        scores = jax.nn.sigmoid(outputs["cls_logits"])  # (B, A, K)
+        boxes = boxes_lib.decode_boxes(
+            anchors[None], outputs["box_deltas"], config.codec
+        )
+        boxes = boxes_lib.clip_boxes(boxes, image_hw)
+        return nms_lib.batched_multiclass_nms(
+            boxes,
+            scores,
+            score_threshold=config.score_threshold,
+            iou_threshold=config.iou_threshold,
+            pre_nms_size=config.pre_nms_size,
+            max_detections=config.max_detections,
+        )
+
+    if mesh is None:
+        return jax.jit(detect)
+
+    sharded = shard_map(
+        detect,
+        mesh=mesh,
+        in_specs=(P(), P(DATA_AXIS)),
+        out_specs=P(DATA_AXIS),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def detections_to_coco(
+    det: nms_lib.Detections,
+    image_ids: np.ndarray,
+    scales: np.ndarray,
+    valid_rows: np.ndarray,
+    label_to_cat_id: dict[int, int],
+) -> list[dict]:
+    """Device Detections (one batch) → COCO result dicts in ORIGINAL coords.
+
+    Boxes come back in resized-image coordinates; dividing by the per-image
+    scale restores original coordinates (SURVEY.md M10 "rescale boxes").
+    """
+    boxes = np.asarray(det.boxes, dtype=np.float64)
+    scores = np.asarray(det.scores, dtype=np.float64)
+    labels = np.asarray(det.labels)
+    valid = np.asarray(det.valid)
+
+    results: list[dict] = []
+    for i in range(boxes.shape[0]):
+        if not valid_rows[i]:
+            continue  # eval padding row
+        inv = 1.0 / float(scales[i])
+        for j in np.flatnonzero(valid[i]):
+            x1, y1, x2, y2 = boxes[i, j] * inv
+            results.append(
+                {
+                    "image_id": int(image_ids[i]),
+                    "category_id": int(label_to_cat_id[int(labels[i, j])]),
+                    "bbox": [x1, y1, x2 - x1, y2 - y1],
+                    "score": float(scores[i, j]),
+                }
+            )
+    return results
+
+
+def coco_gt_from_dataset(dataset: CocoDataset) -> tuple[list[dict], list[int]]:
+    """Ground-truth annotation dicts + image-id list from a CocoDataset.
+
+    Crowd annotations come through with ``iscrowd=1`` and per-annotation
+    areas are preserved, so the oracle's ignore/area-range semantics match
+    pycocotools on real COCO.  For full-fidelity eval construct the dataset
+    with ``keep_empty=True`` (annotation-less images still collect FPs).
+    """
+    gts: list[dict] = []
+    ann_id = 1
+    for rec in dataset.records:
+        for boxes, labels, areas, iscrowd in (
+            (rec.boxes, rec.labels, rec.areas, 0),
+            (rec.crowd_boxes, rec.crowd_labels, rec.crowd_areas, 1),
+        ):
+            for box, label, area in zip(boxes, labels, areas):
+                x1, y1, x2, y2 = (float(v) for v in box)
+                gts.append(
+                    {
+                        "id": ann_id,
+                        "image_id": rec.image_id,
+                        "category_id": dataset.label_to_cat_id[int(label)],
+                        "bbox": [x1, y1, x2 - x1, y2 - y1],
+                        "area": float(area),
+                        "iscrowd": iscrowd,
+                    }
+                )
+                ann_id += 1
+    return gts, [rec.image_id for rec in dataset.records]
+
+
+def collect_detections(
+    state,
+    model,
+    dataset: CocoDataset,
+    batches: Iterable[Batch],
+    config: DetectConfig = DetectConfig(),
+    mesh: Mesh | None = None,
+) -> list[dict]:
+    """Run detection over an eval batch stream → COCO result dicts.
+
+    One detect function is compiled per shape bucket encountered (static
+    shapes, SURVEY.md §7.3 hard part 1); the cache keys on (H, W).
+    """
+    detect_fns: dict[tuple[int, int], Callable] = {}
+    results: list[dict] = []
+    for batch in batches:
+        hw = batch.images.shape[1:3]
+        fn = detect_fns.get(hw)
+        if fn is None:
+            fn = detect_fns[hw] = make_detect_fn(model, hw, config, mesh=mesh)
+        det = jax.device_get(fn(state, jnp.asarray(batch.images)))
+        results.extend(
+            detections_to_coco(
+                det,
+                batch.image_ids,
+                batch.scales,
+                batch.valid,
+                dataset.label_to_cat_id,
+            )
+        )
+    return results
+
+
+def run_coco_eval(
+    state,
+    model,
+    dataset: CocoDataset,
+    batches: Iterable[Batch],
+    config: DetectConfig = DetectConfig(),
+    mesh: Mesh | None = None,
+) -> dict[str, float]:
+    """Full eval pass: detect everything, then mAP via the numpy oracle."""
+    dt = collect_detections(state, model, dataset, batches, config, mesh=mesh)
+    gt, img_ids = coco_gt_from_dataset(dataset)
+    return evaluate_detections(gt, dt, img_ids=img_ids)

@@ -39,7 +39,7 @@ class NormFactory:
         self.kind = kind
         self.dtype = dtype
 
-    def __call__(self, parent: nn.Module, name: str, train: bool) -> Callable:
+    def __call__(self, name: str, train: bool) -> Callable:
         if self.kind == "gn":
             return nn.GroupNorm(
                 num_groups=32, dtype=self.dtype, name=name, param_dtype=jnp.float32
@@ -76,16 +76,16 @@ class BottleneckBlock(nn.Module):
         )
         residual = x
         y = conv(self.filters, 1, 1, "conv1")(x)
-        y = self.norm(self, "norm1", train)(y)
+        y = self.norm("norm1", train)(y)
         y = nn.relu(y)
         y = conv(self.filters, 3, self.stride, "conv2")(y)
-        y = self.norm(self, "norm2", train)(y)
+        y = self.norm("norm2", train)(y)
         y = nn.relu(y)
         y = conv(self.filters * 4, 1, 1, "conv3")(y)
-        y = self.norm(self, "norm3", train)(y)
+        y = self.norm("norm3", train)(y)
         if residual.shape != y.shape:
             residual = conv(self.filters * 4, 1, self.stride, "proj")(x)
-            residual = self.norm(self, "proj_norm", train)(residual)
+            residual = self.norm("proj_norm", train)(residual)
         return nn.relu(y + residual)
 
 
@@ -110,7 +110,7 @@ class ResNet(nn.Module):
             param_dtype=jnp.float32,
             name="stem_conv",
         )(x)
-        x = norm(self, "stem_norm", train)(x)
+        x = norm("stem_norm", train)(x)
         x = nn.relu(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
 

@@ -143,7 +143,9 @@ def make_train_step(
         grads, metrics, new_bs = local_step(state, batch)
         # THE allreduce: Horovod's NCCL ring → one compiled pmean over ICI.
         grads = lax.pmean(grads, DATA_AXIS)
+        num_pos = lax.psum(metrics["num_pos"], DATA_AXIS)  # a count, not a mean
         metrics = lax.pmean(metrics, DATA_AXIS)
+        metrics["num_pos"] = num_pos
         if state.batch_stats:
             new_bs = lax.pmean(new_bs, DATA_AXIS)  # sync-BN semantics
         new_state = state.apply_gradients(grads, new_bs)

@@ -21,3 +21,36 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: long-running integration test")
+
+
+@pytest.fixture(scope="session")
+def tiny_model_and_state():
+    """A 3-class resnet_test RetinaNet + fresh TrainState (fully conv: any HW)."""
+    from batchai_retinanet_horovod_coco_tpu.models import (
+        RetinaNetConfig,
+        build_retinanet,
+    )
+    from batchai_retinanet_horovod_coco_tpu.train import create_train_state
+
+    model = build_retinanet(
+        RetinaNetConfig(
+            num_classes=3,
+            backbone="resnet_test",
+            fpn_channels=32,
+            head_width=32,
+            head_depth=1,
+            dtype=jnp.float32,
+        )
+    )
+    state = create_train_state(
+        model, optax.sgd(1e-2), (1, 64, 64, 3), jax.random.key(0)
+    )
+    return model, state

@@ -1,0 +1,68 @@
+"""Crowd-annotation fidelity: dataset → gt extraction → oracle ignore rules."""
+
+import json
+
+import numpy as np
+import pytest
+
+from batchai_retinanet_horovod_coco_tpu.data import CocoDataset
+from batchai_retinanet_horovod_coco_tpu.evaluate.coco_eval import evaluate_detections
+from batchai_retinanet_horovod_coco_tpu.evaluate.detect import coco_gt_from_dataset
+
+
+@pytest.fixture
+def crowd_dataset(tmp_path):
+    blob = {
+        "images": [
+            {"id": 1, "file_name": "a.jpg", "width": 400, "height": 400},
+            {"id": 2, "file_name": "b.jpg", "width": 400, "height": 400},
+        ],
+        "annotations": [
+            {
+                "id": 1, "image_id": 1, "category_id": 1,
+                "bbox": [10, 10, 50, 50], "area": 2500.0, "iscrowd": 0,
+            },
+            {
+                "id": 2, "image_id": 1, "category_id": 1,
+                "bbox": [200, 200, 100, 100], "area": 7000.0, "iscrowd": 1,
+            },
+            {
+                "id": 3, "image_id": 2, "category_id": 2,
+                "bbox": [0, 0, 30, 30], "area": 900.0, "iscrowd": 0,
+            },
+        ],
+        "categories": [{"id": 1, "name": "a"}, {"id": 2, "name": "b"}],
+    }
+    path = tmp_path / "instances.json"
+    path.write_text(json.dumps(blob))
+    return CocoDataset(str(path), image_dir=str(tmp_path))
+
+
+def test_crowds_kept_separate_from_training_boxes(crowd_dataset):
+    rec = crowd_dataset.records[0]
+    assert rec.boxes.shape == (1, 4)
+    assert rec.crowd_boxes.shape == (1, 4)
+    np.testing.assert_allclose(rec.crowd_boxes[0], [200, 200, 300, 300])
+    # Segmentation area from the json is preserved, not recomputed from bbox.
+    assert rec.crowd_areas[0] == pytest.approx(7000.0)
+    assert rec.areas[0] == pytest.approx(2500.0)
+
+
+def test_gt_extraction_marks_crowds_ignore(crowd_dataset):
+    gts, img_ids = coco_gt_from_dataset(crowd_dataset)
+    assert img_ids == [1, 2]
+    crowds = [g for g in gts if g["iscrowd"]]
+    assert len(crowds) == 1
+    assert crowds[0]["bbox"] == pytest.approx([200, 200, 100, 100])
+
+
+def test_detection_on_crowd_is_ignored_end_to_end(crowd_dataset):
+    gts, img_ids = coco_gt_from_dataset(crowd_dataset)
+    dts = [
+        {"image_id": 1, "category_id": 1, "bbox": [10, 10, 50, 50], "score": 0.9},
+        # Lands inside the crowd region → must be ignored, not an FP.
+        {"image_id": 1, "category_id": 1, "bbox": [210, 210, 40, 40], "score": 0.8},
+        {"image_id": 2, "category_id": 2, "bbox": [0, 0, 30, 30], "score": 0.9},
+    ]
+    stats = evaluate_detections(gts, dts, img_ids=img_ids)
+    assert stats["AP"] == pytest.approx(1.0)

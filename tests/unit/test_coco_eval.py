@@ -1,0 +1,237 @@
+"""Oracle tests for the numpy COCOeval reimplementation.
+
+Every expected value is hand-computed from the published COCOeval bbox
+semantics (101-point interpolation, greedy matching, ignore rules) — the
+style SURVEY.md §4.1 prescribes: tiny fixtures, exact assertions.
+"""
+
+import numpy as np
+import pytest
+
+from batchai_retinanet_horovod_coco_tpu.evaluate.coco_eval import (
+    CocoEval,
+    bbox_iou_xywh,
+    evaluate_detections,
+)
+
+
+def gt(img, cat, bbox, ann_id=None, iscrowd=0):
+    x, y, w, h = bbox
+    return {
+        "id": ann_id or 0,
+        "image_id": img,
+        "category_id": cat,
+        "bbox": [float(v) for v in bbox],
+        "area": float(w * h),
+        "iscrowd": iscrowd,
+    }
+
+
+def dt(img, cat, bbox, score):
+    return {
+        "image_id": img,
+        "category_id": cat,
+        "bbox": [float(v) for v in bbox],
+        "score": float(score),
+    }
+
+
+class TestBboxIou:
+    def test_identical_box(self):
+        a = np.array([[0.0, 0.0, 10.0, 10.0]])
+        iou = bbox_iou_xywh(a, a, np.zeros(1))
+        assert iou[0, 0] == pytest.approx(1.0)
+
+    def test_half_overlap(self):
+        d = np.array([[0.0, 0.0, 10.0, 10.0]])
+        g = np.array([[5.0, 0.0, 10.0, 10.0]])
+        # inter 50, union 150
+        assert bbox_iou_xywh(d, g, np.zeros(1))[0, 0] == pytest.approx(1 / 3)
+
+    def test_crowd_denominator_is_det_area(self):
+        d = np.array([[0.0, 0.0, 10.0, 10.0]])
+        g = np.array([[0.0, 0.0, 100.0, 100.0]])
+        # det fully inside crowd: inter 100 / det area 100 = 1.0
+        assert bbox_iou_xywh(d, g, np.ones(1))[0, 0] == pytest.approx(1.0)
+        assert bbox_iou_xywh(d, g, np.zeros(1))[0, 0] == pytest.approx(0.01)
+
+
+class TestPerfectDetections:
+    def test_single_perfect(self):
+        stats = evaluate_detections(
+            [gt(1, 1, [10, 10, 50, 50])], [dt(1, 1, [10, 10, 50, 50], 0.9)]
+        )
+        assert stats["AP"] == pytest.approx(1.0)
+        assert stats["AP50"] == pytest.approx(1.0)
+        assert stats["AR100"] == pytest.approx(1.0)
+
+    def test_many_images_perfect(self):
+        gts, dts = [], []
+        rng = np.random.default_rng(0)
+        for img in range(1, 6):
+            for k in range(rng.integers(1, 4)):
+                box = [10 * k + 1.0, 5.0 * img, 40.0 + k, 30.0]
+                gts.append(gt(img, 1 + k % 2, box, ann_id=len(gts) + 1))
+                dts.append(dt(img, 1 + k % 2, box, rng.uniform(0.3, 0.9)))
+        stats = evaluate_detections(gts, dts)
+        assert stats["AP"] == pytest.approx(1.0)
+
+    def test_complete_miss(self):
+        stats = evaluate_detections(
+            [gt(1, 1, [0, 0, 10, 10])], [dt(1, 1, [500, 500, 10, 10], 0.9)]
+        )
+        assert stats["AP"] == pytest.approx(0.0)
+
+
+class TestIouThresholdSweep:
+    def test_iou_in_half_open_band(self):
+        # det [0,0,11,10] vs gt [0,0,10,10]: inter 100, union 110 → IoU 0.909;
+        # matches at thresholds 0.50..0.90 (9 of 10) but not 0.95.
+        stats = evaluate_detections(
+            [gt(1, 1, [0, 0, 10, 10])], [dt(1, 1, [0, 0, 11, 10], 0.9)]
+        )
+        assert stats["AP"] == pytest.approx(0.9)
+        assert stats["AP50"] == pytest.approx(1.0)
+        assert stats["AP75"] == pytest.approx(1.0)
+
+    def test_iou_just_over_half(self):
+        # IoU = 60/140 ≈ 0.4286 < 0.5 → no match at any threshold.
+        stats = evaluate_detections(
+            [gt(1, 1, [0, 0, 10, 10])], [dt(1, 1, [4, 0, 10, 10], 0.9)]
+        )
+        assert stats["AP"] == pytest.approx(0.0)
+
+
+class TestPrecisionInterpolation:
+    def test_tp_fp_tp_sequence(self):
+        """2 gts; dets scored [TP 0.9, FP 0.8, TP 0.7].
+
+        rc = [.5, .5, 1.], pr = [1, .5, 2/3] → envelope [1, 2/3, 2/3];
+        101-pt AP = (51·1 + 50·(2/3)) / 101.
+        """
+        gts = [gt(1, 1, [0, 0, 10, 10], 1), gt(1, 1, [100, 100, 10, 10], 2)]
+        dts = [
+            dt(1, 1, [0, 0, 10, 10], 0.9),
+            dt(1, 1, [300, 300, 10, 10], 0.8),
+            dt(1, 1, [100, 100, 10, 10], 0.7),
+        ]
+        stats = evaluate_detections(gts, dts)
+        expected = (51 * 1.0 + 50 * (2.0 / 3.0)) / 101
+        assert stats["AP"] == pytest.approx(expected)
+        assert stats["AR100"] == pytest.approx(1.0)
+
+    def test_missed_gt_halves_recall(self):
+        gts = [gt(1, 1, [0, 0, 10, 10], 1), gt(1, 1, [100, 100, 10, 10], 2)]
+        dts = [dt(1, 1, [0, 0, 10, 10], 0.9)]
+        stats = evaluate_detections(gts, dts)
+        # Recall caps at 0.5 with precision 1: 51 recall points reachable.
+        assert stats["AP"] == pytest.approx(51 / 101)
+        assert stats["AR100"] == pytest.approx(0.5)
+
+
+class TestGreedyMatching:
+    def test_higher_score_takes_gt(self):
+        # Two dets overlap one gt; high-score det matches, other is FP.
+        gts = [gt(1, 1, [0, 0, 10, 10])]
+        dts = [
+            dt(1, 1, [0, 0, 10, 10], 0.6),
+            dt(1, 1, [1, 0, 10, 10], 0.9),  # IoU 9/11 ≈ 0.818 — would match
+        ]
+        ev = CocoEval(gts, dts)
+        ev.evaluate()
+        e = ev.eval_imgs[(0, 0, 1)]
+        # At IoU thr 0.5 (t=0): the 0.9-score det (sorted first) matched.
+        assert e["dt_matched"][0].tolist() == [True, False]
+
+    def test_det_prefers_higher_iou_gt(self):
+        gts = [gt(1, 1, [0, 0, 10, 10], 1), gt(1, 1, [2, 0, 10, 10], 2)]
+        dts = [dt(1, 1, [2, 0, 10, 10], 0.9)]
+        ev = CocoEval(gts, dts)
+        ev.evaluate()
+        # Det matches gt #2 exactly (IoU 1.0 beats 8/12).
+        assert ev.eval_imgs[(0, 0, 1)]["dt_matched"][0].tolist() == [True]
+        stats = evaluate_detections(gts, dts)
+        assert stats["AR100"] == pytest.approx(0.5)
+
+
+class TestIgnoreRules:
+    def test_crowd_match_is_neither_tp_nor_fp(self):
+        gts = [
+            gt(1, 1, [0, 0, 10, 10], 1),
+            gt(1, 1, [100, 100, 50, 50], 2, iscrowd=1),
+        ]
+        dts = [
+            dt(1, 1, [0, 0, 10, 10], 0.9),
+            dt(1, 1, [110, 110, 20, 20], 0.8),  # inside the crowd region
+        ]
+        stats = evaluate_detections(gts, dts)
+        # Crowd det ignored → precision stays 1.0 → AP 1.0.
+        assert stats["AP"] == pytest.approx(1.0)
+
+    def test_fp_on_empty_image_counts(self):
+        gts = [gt(1, 1, [0, 0, 10, 10])]
+        dts = [
+            dt(1, 1, [0, 0, 10, 10], 0.6),
+            dt(2, 1, [0, 0, 10, 10], 0.9),  # image 2 has no gt → FP
+        ]
+        stats = evaluate_detections(gts, dts, img_ids=[1, 2])
+        # Global order: FP(0.9) then TP(0.6): pr=[0, .5], and the monotone
+        # envelope lifts precision at every recall point to .5.
+        assert stats["AP"] == pytest.approx(0.5)
+
+
+class TestAreaRanges:
+    def test_small_gt_excluded_from_large(self):
+        # 16x16 = 256 < 32² → small. Perfect det.
+        stats = evaluate_detections(
+            [gt(1, 1, [0, 0, 16, 16])], [dt(1, 1, [0, 0, 16, 16], 0.9)]
+        )
+        assert stats["APsmall"] == pytest.approx(1.0)
+        assert stats["APmedium"] == -1.0  # no gt in range → undefined
+        assert stats["APlarge"] == -1.0
+
+    def test_medium_and_large(self):
+        stats = evaluate_detections(
+            [
+                gt(1, 1, [0, 0, 50, 50], 1),      # 2500 → medium
+                gt(1, 1, [200, 200, 100, 100], 2),  # 10000 → large
+            ],
+            [
+                dt(1, 1, [0, 0, 50, 50], 0.9),
+                dt(1, 1, [200, 200, 100, 100], 0.8),
+            ],
+        )
+        assert stats["APmedium"] == pytest.approx(1.0)
+        assert stats["APlarge"] == pytest.approx(1.0)
+        assert stats["AP"] == pytest.approx(1.0)
+
+
+class TestMaxDets:
+    def test_ar1_uses_only_top_det(self):
+        gts = [gt(1, 1, [0, 0, 10, 10], 1), gt(1, 1, [100, 100, 10, 10], 2)]
+        dts = [
+            dt(1, 1, [0, 0, 10, 10], 0.9),
+            dt(1, 1, [100, 100, 10, 10], 0.8),
+        ]
+        stats = evaluate_detections(gts, dts)
+        assert stats["AR1"] == pytest.approx(0.5)
+        assert stats["AR10"] == pytest.approx(1.0)
+
+
+class TestMultiClass:
+    def test_classes_evaluated_independently(self):
+        gts = [gt(1, 1, [0, 0, 10, 10], 1), gt(1, 2, [100, 100, 10, 10], 2)]
+        dts = [
+            dt(1, 1, [0, 0, 10, 10], 0.9),       # perfect for cat 1
+            dt(1, 2, [300, 300, 10, 10], 0.8),   # miss for cat 2
+        ]
+        stats = evaluate_detections(gts, dts)
+        # cat1 AP 1.0, cat2 AP 0.0 → mean 0.5
+        assert stats["AP"] == pytest.approx(0.5)
+
+    def test_wrong_class_is_fp(self):
+        gts = [gt(1, 1, [0, 0, 10, 10])]
+        dts = [dt(1, 2, [0, 0, 10, 10], 0.9)]
+        stats = evaluate_detections(gts, dts)
+        # cat1: no det → AP 0. cat2: no gt → undefined (excluded).
+        assert stats["AP"] == pytest.approx(0.0)

@@ -121,6 +121,56 @@ def test_sharding_partitions_records(synthetic_dataset):
     assert sorted(ids) == sorted(r.image_id for r in synthetic_dataset.records)
 
 
+def test_oversized_image_shrinks_to_fit_bucket(tmp_path):
+    """An image no bucket fits is scaled down, not crashed on (bucket cap)."""
+    from batchai_retinanet_horovod_coco_tpu.data import make_synthetic_coco
+
+    ann = make_synthetic_coco(
+        str(tmp_path), num_images=2, num_classes=2, image_size=(96, 400), seed=2
+    )
+    ds = CocoDataset(ann, image_dir=f"{tmp_path}/train")
+    # min_side=96 → scale 1.0 → 96x400 exceeds the only (128, 128) bucket.
+    cfg = PipelineConfig(
+        batch_size=2,
+        buckets=((128, 128),),
+        min_side=96,
+        max_side=400,
+        max_gt=8,
+        num_workers=1,
+        hflip_prob=0.0,
+    )
+    batch = next(build_pipeline(ds, cfg, train=True))
+    assert batch.images.shape == (2, 128, 128, 3)
+    valid = batch.gt_boxes[batch.gt_mask]
+    assert np.all(valid <= 128 + 1e-3)
+    # scale reflects the extra shrink (128/400), so eval rescaling stays exact.
+    assert batch.scales[0] == pytest.approx(128 / 400)
+
+
+def test_abandoned_iterator_stops_producer(synthetic_dataset):
+    """Closing the iterator must unblock and terminate the producer thread."""
+    import threading
+    import time
+
+    cfg = PipelineConfig(
+        batch_size=1,
+        buckets=((320, 320),),
+        min_side=300,
+        max_side=320,
+        max_gt=8,
+        num_workers=2,
+        prefetch=1,
+    )
+    before = threading.active_count()
+    it = build_pipeline(synthetic_dataset, cfg, train=True)
+    next(it)  # producer is now live and blocked on the full prefetch queue
+    it.close()
+    deadline = time.time() + 10
+    while time.time() < deadline and threading.active_count() > before:
+        time.sleep(0.05)
+    assert threading.active_count() <= before
+
+
 def test_determinism_same_seed(synthetic_dataset):
     cfg = PipelineConfig(
         batch_size=2,

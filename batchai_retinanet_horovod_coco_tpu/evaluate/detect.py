@@ -32,6 +32,7 @@ from batchai_retinanet_horovod_coco_tpu.ops import anchors as anchors_lib
 from batchai_retinanet_horovod_coco_tpu.ops import boxes as boxes_lib
 from batchai_retinanet_horovod_coco_tpu.ops import nms as nms_lib
 from batchai_retinanet_horovod_coco_tpu.parallel.mesh import DATA_AXIS
+from batchai_retinanet_horovod_coco_tpu.train.state import model_variables
 
 
 @dataclasses.dataclass(frozen=True)
@@ -62,10 +63,7 @@ def make_detect_fn(
     )
 
     def detect(state, images: jnp.ndarray) -> nms_lib.Detections:
-        variables = {"params": state.params}
-        if state.batch_stats:
-            variables["batch_stats"] = state.batch_stats
-        outputs = model.apply(variables, images, train=False)
+        outputs = model.apply(model_variables(state), images, train=False)
         scores = jax.nn.sigmoid(outputs["cls_logits"])  # (B, A, K)
         boxes = boxes_lib.decode_boxes(
             anchors[None], outputs["box_deltas"], config.codec
@@ -99,11 +97,16 @@ def detections_to_coco(
     scales: np.ndarray,
     valid_rows: np.ndarray,
     label_to_cat_id: dict[int, int],
+    image_sizes: dict[int, tuple[int, int]] | None = None,
 ) -> list[dict]:
     """Device Detections (one batch) → COCO result dicts in ORIGINAL coords.
 
     Boxes come back in resized-image coordinates; dividing by the per-image
     scale restores original coordinates (SURVEY.md M10 "rescale boxes").
+    The device-side clip is to the static bucket extent (which includes
+    padding), so with ``image_sizes`` ({image_id: (width, height)}) boxes are
+    re-clamped to the true image bounds here; degenerate (zero-area) boxes —
+    e.g. spurious hits entirely inside the padding — are dropped.
     """
     boxes = np.asarray(det.boxes, dtype=np.float64)
     scores = np.asarray(det.scores, dtype=np.float64)
@@ -115,11 +118,18 @@ def detections_to_coco(
         if not valid_rows[i]:
             continue  # eval padding row
         inv = 1.0 / float(scales[i])
+        img_id = int(image_ids[i])
+        wh = image_sizes.get(img_id) if image_sizes else None
         for j in np.flatnonzero(valid[i]):
             x1, y1, x2, y2 = boxes[i, j] * inv
+            if wh is not None:
+                x1, x2 = np.clip([x1, x2], 0.0, wh[0])
+                y1, y2 = np.clip([y1, y2], 0.0, wh[1])
+                if x2 <= x1 or y2 <= y1:
+                    continue
             results.append(
                 {
-                    "image_id": int(image_ids[i]),
+                    "image_id": img_id,
                     "category_id": int(label_to_cat_id[int(labels[i, j])]),
                     "bbox": [x1, y1, x2 - x1, y2 - y1],
                     "score": float(scores[i, j]),
@@ -173,6 +183,9 @@ def collect_detections(
     shapes, SURVEY.md §7.3 hard part 1); the cache keys on (H, W).
     """
     detect_fns: dict[tuple[int, int], Callable] = {}
+    image_sizes = {
+        rec.image_id: (rec.width, rec.height) for rec in dataset.records
+    }
     results: list[dict] = []
     for batch in batches:
         hw = batch.images.shape[1:3]
@@ -187,6 +200,7 @@ def collect_detections(
                 batch.scales,
                 batch.valid,
                 dataset.label_to_cat_id,
+                image_sizes=image_sizes,
             )
         )
     return results

@@ -6,7 +6,11 @@ functional JAX loop: an optax optimizer, an explicit TrainState pytree, and
 ONE jit-compiled SPMD train step per shape bucket.
 """
 
-from batchai_retinanet_horovod_coco_tpu.train.state import TrainState, create_train_state
+from batchai_retinanet_horovod_coco_tpu.train.state import (
+    TrainState,
+    create_train_state,
+    model_variables,
+)
 from batchai_retinanet_horovod_coco_tpu.train.step import make_eval_forward, make_train_step
 
 __all__ = [
@@ -14,4 +18,5 @@ __all__ = [
     "create_train_state",
     "make_eval_forward",
     "make_train_step",
+    "model_variables",
 ]

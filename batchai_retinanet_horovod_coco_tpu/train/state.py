@@ -22,6 +22,19 @@ import jax.numpy as jnp
 import optax
 
 
+def model_variables(state: "TrainState") -> dict[str, Any]:
+    """Flax variables dict for ``model.apply`` from a TrainState.
+
+    The single place that knows which variable collections exist; forward
+    paths (train step, eval forward, detection) all assemble through here so
+    a new collection (e.g. EMA params) propagates everywhere at once.
+    """
+    variables: dict[str, Any] = {"params": state.params}
+    if state.batch_stats:
+        variables["batch_stats"] = state.batch_stats
+    return variables
+
+
 @flax.struct.dataclass
 class TrainState:
     step: jnp.ndarray

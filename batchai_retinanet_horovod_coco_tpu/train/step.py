@@ -30,7 +30,7 @@ from batchai_retinanet_horovod_coco_tpu import losses as losses_lib
 from batchai_retinanet_horovod_coco_tpu.ops import anchors as anchors_lib
 from batchai_retinanet_horovod_coco_tpu.ops import matching as matching_lib
 from batchai_retinanet_horovod_coco_tpu.parallel.mesh import DATA_AXIS
-from batchai_retinanet_horovod_coco_tpu.train.state import TrainState
+from batchai_retinanet_horovod_coco_tpu.train.state import TrainState, model_variables
 
 
 def _forward_and_loss(
@@ -47,10 +47,8 @@ def _forward_and_loss(
     matching_config: matching_lib.MatchingConfig,
     train: bool,
 ):
-    variables = {"params": params}
-    has_bn = bool(state.batch_stats)
-    if has_bn:
-        variables["batch_stats"] = state.batch_stats
+    variables = dict(model_variables(state), params=params)
+    has_bn = "batch_stats" in variables
 
     if has_bn and train:
         outputs, mutated = model.apply(
@@ -167,10 +165,7 @@ def make_eval_forward(
     """
 
     def forward(state: TrainState, images: jnp.ndarray):
-        variables = {"params": state.params}
-        if state.batch_stats:
-            variables["batch_stats"] = state.batch_stats
-        return model.apply(variables, images, train=False)
+        return model.apply(model_variables(state), images, train=False)
 
     if mesh is None:
         return jax.jit(forward)

@@ -1,0 +1,126 @@
+"""Benchmark: flagship train-step throughput, printed as ONE JSON line.
+
+Measures images/sec/chip for the full jitted SPMD training step (forward,
+on-device target assignment, focal + smooth-L1 losses, backward, optimizer
+update) on RetinaNet ResNet-50-FPN at the reference's flagship resolution
+bucket (800x1344, BASELINE.json:10), bf16 compute.
+
+``vs_baseline``: the reference's own throughput was never recorded
+(BASELINE.json "published": {}, see BASELINE.md), so the ratio is computed
+against the first recorded bench of this rebuild (BENCH_r1.json) when
+present, else 1.0 — i.e. it tracks round-over-round improvement.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+BUCKET = (800, 1344)
+WARMUP_STEPS = 3
+MEASURE_STEPS = 10
+
+
+def make_batch(batch_size: int, hw: tuple[int, int], max_gt: int = 100):
+    rng = np.random.default_rng(0)
+    h, w = hw
+    gt_boxes = np.zeros((batch_size, max_gt, 4), np.float32)
+    gt_labels = np.zeros((batch_size, max_gt), np.int32)
+    gt_mask = np.zeros((batch_size, max_gt), bool)
+    for b in range(batch_size):
+        n = int(rng.integers(4, 24))
+        xy = rng.uniform(0, [w - 64, h - 64], (n, 2))
+        wh = rng.uniform(16, 256, (n, 2))
+        gt_boxes[b, :n, 0::2] = np.stack([xy[:, 0], np.minimum(xy[:, 0] + wh[:, 0], w)], 1)
+        gt_boxes[b, :n, 1::2] = np.stack([xy[:, 1], np.minimum(xy[:, 1] + wh[:, 1], h)], 1)
+        gt_labels[b, :n] = rng.integers(0, 80, n)
+        gt_mask[b, :n] = True
+    return {
+        "images": jnp.asarray(
+            rng.normal(0, 1, (batch_size, h, w, 3)).astype(np.float32)
+        ),
+        "gt_boxes": jnp.asarray(gt_boxes),
+        "gt_labels": jnp.asarray(gt_labels),
+        "gt_mask": jnp.asarray(gt_mask),
+    }
+
+
+def run_bench(batch_size: int) -> float:
+    from batchai_retinanet_horovod_coco_tpu.models import (
+        RetinaNetConfig,
+        build_retinanet,
+    )
+    from batchai_retinanet_horovod_coco_tpu.train import (
+        create_train_state,
+        make_train_step,
+    )
+
+    model = build_retinanet(RetinaNetConfig(num_classes=80, backbone="resnet50"))
+    state = create_train_state(
+        model, optax.sgd(0.01, momentum=0.9), (1, *BUCKET, 3), jax.random.key(0)
+    )
+    step = make_train_step(model, BUCKET, 80, donate_state=True)
+    batch = make_batch(batch_size, BUCKET)
+
+    for _ in range(WARMUP_STEPS):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics)
+
+    t0 = time.perf_counter()
+    for _ in range(MEASURE_STEPS):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics)
+    dt = time.perf_counter() - t0
+    assert np.isfinite(float(metrics["loss"]))
+    return batch_size * MEASURE_STEPS / dt
+
+
+def first_recorded_bench() -> float | None:
+    vals = {}
+    for path in glob.glob(os.path.join(os.path.dirname(__file__) or ".", "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                vals[int(m.group(1))] = float(json.load(f)["value"])
+        except Exception:
+            continue
+    return vals[min(vals)] if vals else None
+
+
+def main() -> None:
+    batch_size = int(os.environ.get("BENCH_BATCH", "8"))
+    try:
+        ips = run_bench(batch_size)
+    except Exception as e:  # retry smaller before giving up (e.g. HBM OOM)
+        if batch_size <= 2:
+            raise
+        print(f"# batch {batch_size} failed ({type(e).__name__}); retrying at 2", flush=True)
+        batch_size = 2
+        ips = run_bench(batch_size)
+
+    baseline = first_recorded_bench()
+    value = round(ips, 3)
+    print(
+        json.dumps(
+            {
+                "metric": "train_images_per_sec_per_chip",
+                "value": value,
+                "unit": "images/sec/chip",
+                "vs_baseline": round(value / baseline, 4) if baseline else 1.0,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

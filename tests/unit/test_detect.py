@@ -43,6 +43,28 @@ class TestDetectionsToCoco:
         assert r["bbox"] == pytest.approx([5.0, 10.0, 10.0, 20.0])
         assert r["score"] == pytest.approx(0.9)
 
+    def test_clamp_to_original_image_bounds(self):
+        # Bucket padding lets device boxes extend past the true image; with
+        # image_sizes they must be clamped, and padding-only boxes dropped.
+        det = Detections(
+            boxes=jnp.array(
+                [[[90.0, 10.0, 140.0, 40.0], [120.0, 5.0, 160.0, 30.0]]]
+            ),
+            scores=jnp.array([[0.8, 0.4]]),
+            labels=jnp.array([[0, 0]], dtype=jnp.int32),
+            valid=jnp.array([[True, True]]),
+        )
+        out = detections_to_coco(
+            det,
+            image_ids=np.array([7]),
+            scales=np.array([1.0]),
+            valid_rows=np.array([True]),
+            label_to_cat_id={0: 1},
+            image_sizes={7: (100, 50)},  # true image is 100 wide
+        )
+        assert len(out) == 1  # box fully inside padding (x>=120) dropped
+        assert out[0]["bbox"] == pytest.approx([90.0, 10.0, 10.0, 30.0])
+
     def test_padding_rows_skipped(self):
         det = Detections(
             boxes=jnp.zeros((2, 1, 4)),

@@ -1,0 +1,15 @@
+"""Multi-host bring-up: the Cloud TPU pod-slice launcher layer.
+
+Replaces the reference's L5/H4 stack (SURVEY.md §2.1 W3/W4, §2.3 H4): Azure
+Batch AI cluster provisioning + ``mpirun`` process launch + MPI rank
+discovery.  On TPU pods the same ``train.py`` binary runs on every host and
+``jax.distributed.initialize()`` replaces the MPI world bootstrap.
+"""
+
+from batchai_retinanet_horovod_coco_tpu.launch.pod import (
+    DistributedConfig,
+    initialize_distributed,
+    shard_info,
+)
+
+__all__ = ["DistributedConfig", "initialize_distributed", "shard_info"]

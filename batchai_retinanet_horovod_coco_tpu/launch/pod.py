@@ -1,0 +1,64 @@
+"""TPU pod-slice process bring-up (the mpirun/MPI replacement).
+
+Reference call stack 3.1 (SURVEY.md): Batch AI schedules N VMs, ``mpirun``
+forks one process per GPU, ranks discover each other through MPI, and
+``hvd.init()`` joins the world.  TPU-native equivalent: the SAME ``train.py``
+is started once per host (by the pod launcher / `gcloud compute tpus ssh
+--worker=all`), and ``jax.distributed.initialize()`` performs coordinator
+discovery — on Cloud TPU VMs entirely from environment metadata, so the
+zero-argument call is the whole bootstrap.  After it returns,
+``jax.devices()`` is the GLOBAL device list and the mesh code
+(parallel/mesh.py) works unchanged from 1 chip to a v5e-256 slice.
+
+For CI / laptops the explicit (coordinator, num_processes, process_id) form
+brings up a multi-process CPU "pod" (tests/distributed/test_pod_launch.py),
+the analogue the reference never had (SURVEY.md §4: distributed testing —
+none).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedConfig:
+    """How to join (or not join) a multi-process world.
+
+    Default: single-process — ``initialize_distributed`` is a no-op, which is
+    the 1-host dev path.  ``auto=True``: zero-argument
+    ``jax.distributed.initialize()`` using Cloud TPU metadata.  Explicit
+    coordinator fields: manual bring-up (CI, CPU multi-process).
+    """
+
+    auto: bool = False
+    coordinator_address: str | None = None
+    num_processes: int | None = None
+    process_id: int | None = None
+    local_device_ids: tuple[int, ...] | None = None
+
+
+def initialize_distributed(config: DistributedConfig = DistributedConfig()) -> None:
+    """Join the multi-process world per ``config``; idempotent for 1 process."""
+    if config.auto:
+        jax.distributed.initialize()
+        return
+    if config.coordinator_address is not None:
+        jax.distributed.initialize(
+            coordinator_address=config.coordinator_address,
+            num_processes=config.num_processes,
+            process_id=config.process_id,
+            local_device_ids=config.local_device_ids,
+        )
+    # else: single-process run; nothing to do.
+
+
+def shard_info() -> tuple[int, int]:
+    """(shard_index, shard_count) for host data sharding = (process, #processes).
+
+    The grain/tf.data idiom replacing Horovod's per-rank generator seeding
+    (SURVEY.md M8): each host reads records[process_index::process_count].
+    """
+    return jax.process_index(), jax.process_count()

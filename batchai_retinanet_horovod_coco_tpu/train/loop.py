@@ -1,0 +1,163 @@
+"""The training loop: the reference's ``model.fit_generator`` equivalent.
+
+SURVEY.md call stack 3.2: Keras ``fit_generator`` + callback list
+(BroadcastGlobalVariables, ModelCheckpoint, CocoEval, TensorBoard) becomes an
+explicit step loop: pull a host batch, dispatch the jitted SPMD step for that
+batch's shape bucket (one compiled program per bucket, cached here), log
+device-averaged metrics, checkpoint/eval on schedule.  There is no broadcast
+callback — initial weights are identical on every process by PRNG
+construction (train/state.py) — and no RedirectModel/convert step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Iterable, Iterator
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from batchai_retinanet_horovod_coco_tpu import losses as losses_lib
+from batchai_retinanet_horovod_coco_tpu.data.pipeline import Batch
+from batchai_retinanet_horovod_coco_tpu.ops import matching as matching_lib
+from batchai_retinanet_horovod_coco_tpu.parallel.mesh import (
+    batch_sharding,
+    replicated_sharding,
+)
+from batchai_retinanet_horovod_coco_tpu.train.state import TrainState
+from batchai_retinanet_horovod_coco_tpu.train.step import make_train_step
+from batchai_retinanet_horovod_coco_tpu.utils.checkpoint import CheckpointManager
+from batchai_retinanet_horovod_coco_tpu.utils.metrics import MetricLogger
+
+
+@dataclasses.dataclass(frozen=True)
+class LoopConfig:
+    total_steps: int = 1000
+    log_every: int = 20
+    checkpoint_every: int = 0  # 0 = no checkpointing
+    eval_every: int = 0  # 0 = eval only at the end (if eval_fn given)
+    checkpoint_dir: str | None = None
+    resume: bool = True  # resume from latest checkpoint if present
+    max_to_keep: int = 3
+
+
+def _device_batch(batch: Batch, mesh: Mesh | None) -> dict[str, Any]:
+    """Host Batch → the dict the train step consumes, globally sharded.
+
+    Multi-host: each process holds its LOCAL shard of the global batch; the
+    global jax.Array is assembled per process via
+    ``make_array_from_process_local_data`` (the grain idiom).  Single-host:
+    plain arrays, jit shards them per in_specs.
+    """
+    arrays = {
+        "images": batch.images,
+        "gt_boxes": batch.gt_boxes,
+        "gt_labels": batch.gt_labels,
+        "gt_mask": batch.gt_mask,
+    }
+    if mesh is None or jax.process_count() == 1:
+        return arrays
+    sharding = batch_sharding(mesh)
+    return {
+        k: jax.make_array_from_process_local_data(sharding, v)
+        for k, v in arrays.items()
+    }
+
+
+def run_training(
+    model,
+    state: TrainState,
+    batches: Iterable[Batch],
+    num_classes: int,
+    config: LoopConfig,
+    mesh: Mesh | None = None,
+    loss_config: losses_lib.LossConfig = losses_lib.LossConfig(),
+    matching_config: matching_lib.MatchingConfig = matching_lib.MatchingConfig(),
+    schedule: Callable[[int], float] | None = None,
+    eval_fn: Callable[[TrainState], dict[str, float]] | None = None,
+    logger: MetricLogger | None = None,
+) -> TrainState:
+    """Run ``config.total_steps`` of SPMD training; returns the final state.
+
+    ``eval_fn(state) -> metrics`` is the CocoEval-callback equivalent, called
+    every ``eval_every`` steps and at the end.  One train step is compiled
+    per (H, W) shape bucket seen in the stream.
+    """
+    logger = logger or MetricLogger(log_dir=None)
+    ckpt = None
+    if config.checkpoint_every and config.checkpoint_dir:
+        ckpt = CheckpointManager(
+            config.checkpoint_dir,
+            max_to_keep=config.max_to_keep,
+            save_interval_steps=config.checkpoint_every,
+        )
+        if config.resume and ckpt.latest_step() is not None:
+            state = ckpt.restore(state)
+            print(f"resumed from step {int(state.step)}", flush=True)
+
+    if mesh is not None:
+        # Replicate state over the mesh (restored arrays land committed to a
+        # single device, which conflicts with the shard_map'd step).
+        state = jax.device_put(state, replicated_sharding(mesh))
+
+    step_fns: dict[tuple[int, int], Callable] = {}
+    start_step = int(state.step)
+    last_saved: int | None = None
+    window_t0 = time.perf_counter()
+    window_images = 0
+    metrics = None
+    it: Iterator[Batch] = iter(batches)
+
+    for step in range(start_step + 1, config.total_steps + 1):
+        batch = next(it)
+        hw = batch.images.shape[1:3]
+        step_fn = step_fns.get(hw)
+        if step_fn is None:
+            step_fn = step_fns[hw] = make_train_step(
+                model,
+                hw,
+                num_classes,
+                mesh=mesh,
+                loss_config=loss_config,
+                matching_config=matching_config,
+            )
+        state, metrics = step_fn(state, _device_batch(batch, mesh))
+        # Global batch size = local batch × process_count (each process
+        # feeds its shard of the global batch).
+        window_images += batch.images.shape[0] * (
+            jax.process_count() if mesh is not None else 1
+        )
+
+        # ``step`` is tracked host-side (state.step mirrors it) so the loop
+        # never forces a per-step device sync on tunneled TPU backends.
+        if step % config.log_every == 0 or step == config.total_steps:
+            scalars = {k: v for k, v in jax.device_get(metrics).items()}
+            dt = time.perf_counter() - window_t0
+            scalars["images_per_sec"] = window_images / max(dt, 1e-9)
+            if schedule is not None:
+                scalars["lr"] = float(schedule(step - 1))
+            logger.log(step, scalars)
+            window_t0 = time.perf_counter()
+            window_images = 0
+
+        if ckpt is not None and ckpt.save(state, step=step):
+            last_saved = step
+
+        if (
+            eval_fn is not None
+            and config.eval_every
+            and step % config.eval_every == 0
+            and step < config.total_steps
+        ):
+            logger.log(step, eval_fn(state), prefix="eval")
+
+    final_step = max(start_step, config.total_steps)
+    if eval_fn is not None:
+        logger.log(final_step, eval_fn(state), prefix="eval")
+    if ckpt is not None:
+        if last_saved != final_step:
+            ckpt.save(state, step=final_step, force=True)
+        ckpt.close()
+    return state

@@ -1,0 +1,99 @@
+"""Optimizer + LR schedule factory.
+
+Reference training hyper-surface (SURVEY.md M11/W1): Adam at a small base LR
+scaled by ``hvd.size()`` (linear-scaling rule), ReduceLROnPlateau, optional
+``--freeze-backbone``.  TPU-native redesign: everything is an optax chain
+built ONCE — the schedule is a pure function of the step (compiled into the
+train step; no callback machinery), warmup replaces the Horovod
+LearningRateWarmup callback, and backbone freezing is a gradient mask rather
+than layer.trainable flips (no graph rebuild).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import optax
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimizerConfig:
+    optimizer: str = "sgd"  # "sgd" | "adam"
+    base_lr: float = 0.01  # per-256-global-batch for sgd (detectron rule)
+    # Linear-scaling rule: effective lr = base_lr * global_batch / 256 for
+    # sgd, or base_lr * world_size for adam (the reference's hvd.size() rule).
+    global_batch_size: int = 16
+    world_size: int = 1
+    warmup_steps: int = 500
+    total_steps: int = 90_000
+    schedule: str = "multistep"  # "multistep" | "cosine" | "constant"
+    # Multistep: decay 10x at these fractions of total_steps (detectron 1x).
+    milestones: tuple[float, ...] = (2 / 3, 8 / 9)
+    momentum: float = 0.9
+    weight_decay: float = 1e-4
+    clip_global_norm: float = 10.0
+    freeze_backbone: bool = False
+
+
+def peak_lr(config: OptimizerConfig) -> float:
+    if config.optimizer == "adam":
+        return config.base_lr * config.world_size
+    return config.base_lr * config.global_batch_size / 256.0
+
+
+def make_schedule(config: OptimizerConfig) -> optax.Schedule:
+    peak = peak_lr(config)
+    # join_schedules rebases the post-warmup schedule to step 0 at the join,
+    # so boundaries/horizons are expressed relative to the end of warmup —
+    # milestones land at the intended GLOBAL step.
+    if config.schedule == "constant":
+        sched = optax.constant_schedule(peak)
+    elif config.schedule == "cosine":
+        sched = optax.cosine_decay_schedule(
+            peak, max(1, config.total_steps - config.warmup_steps)
+        )
+    elif config.schedule == "multistep":
+        boundaries = {
+            int(m * config.total_steps) - config.warmup_steps: 0.1
+            for m in config.milestones
+        }
+        sched = optax.piecewise_constant_schedule(peak, boundaries)
+    else:
+        raise ValueError(f"unknown schedule: {config.schedule!r}")
+    if config.warmup_steps > 0:
+        warmup = optax.linear_schedule(
+            peak / max(1, config.warmup_steps), peak, config.warmup_steps
+        )
+        return optax.join_schedules([warmup, sched], [config.warmup_steps])
+    return sched
+
+
+def make_optimizer(
+    config: OptimizerConfig,
+) -> tuple[optax.GradientTransformation, optax.Schedule]:
+    """(transform, schedule) — schedule returned separately for logging."""
+    schedule = make_schedule(config)
+    if config.optimizer == "sgd":
+        core = optax.chain(
+            optax.add_decayed_weights(config.weight_decay),
+            optax.sgd(schedule, momentum=config.momentum),
+        )
+    elif config.optimizer == "adam":
+        core = optax.adam(schedule)
+    else:
+        raise ValueError(f"unknown optimizer: {config.optimizer!r}")
+
+    parts = [optax.clip_by_global_norm(config.clip_global_norm), core]
+    tx = optax.chain(*parts)
+
+    if config.freeze_backbone:
+        # Zero gradients for the backbone subtree (reference --freeze-backbone).
+        def label(params):
+            return {
+                k: ("frozen" if k == "backbone" else "trained") for k in params
+            }
+
+        tx = optax.multi_transform(
+            {"trained": tx, "frozen": optax.set_to_zero()}, label
+        )
+    return tx, schedule

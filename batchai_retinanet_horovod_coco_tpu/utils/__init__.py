@@ -1,0 +1,14 @@
+"""Host-side utilities: checkpointing, metrics, profiling.
+
+The reference's equivalents (SURVEY.md §5): Keras ``ModelCheckpoint`` on
+rank 0 (§5.4), TensorBoard scalar callbacks + Horovod MetricAverage (§5.5),
+and nothing for profiling beyond stdout (§5.1).
+"""
+
+from batchai_retinanet_horovod_coco_tpu.utils.checkpoint import (
+    CheckpointManager,
+    latest_step,
+)
+from batchai_retinanet_horovod_coco_tpu.utils.metrics import MetricLogger
+
+__all__ = ["CheckpointManager", "MetricLogger", "latest_step"]

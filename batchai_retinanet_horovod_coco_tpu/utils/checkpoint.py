@@ -1,0 +1,107 @@
+"""Checkpoint/resume on top of orbax.
+
+Reference behavior being replaced (SURVEY.md §5.4): Keras ``ModelCheckpoint``
+on rank 0 wrote one full-model ``.h5`` per epoch WITHOUT optimizer state, so
+resume restarted the optimizer; a separate ``convert_model.py`` produced the
+inference snapshot.  Here the FULL train state (params + batch_stats +
+optimizer state + step) is saved via orbax — async, multi-host-aware (every
+process participates in the save of its addressable shards; orbax handles
+coordination) — and resume is bit-exact.  No conversion step exists because
+inference is just another jitted function over the same params
+(evaluate/detect.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+from batchai_retinanet_horovod_coco_tpu.train.state import TrainState
+
+
+def _saveable(state: TrainState) -> dict[str, Any]:
+    """The pytree that goes to disk (drops the static optax transform)."""
+    return {
+        "step": state.step,
+        "params": state.params,
+        "batch_stats": state.batch_stats,
+        "opt_state": state.opt_state,
+    }
+
+
+class CheckpointManager:
+    """Thin wrapper over ``ocp.CheckpointManager`` for TrainState pytrees."""
+
+    def __init__(
+        self,
+        directory: str,
+        max_to_keep: int = 3,
+        save_interval_steps: int = 1,
+    ):
+        self._mgr = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                create=True,
+                enable_async_checkpointing=True,
+            ),
+        )
+
+    @property
+    def directory(self) -> str:
+        return str(self._mgr.directory)
+
+    def save(
+        self, state: TrainState, step: int | None = None, force: bool = False
+    ) -> bool:
+        """Async-save at ``step`` (default: ``state.step``, which costs a
+        device sync — pass the host-tracked step in hot loops)."""
+        return self._mgr.save(
+            int(state.step) if step is None else step,
+            args=ocp.args.StandardSave(_saveable(state)),
+            force=force,
+        )
+
+    def restore(self, state: TrainState, step: int | None = None) -> TrainState:
+        """Restore into the structure of ``state`` (shapes/shardings template).
+
+        ``state`` must be a freshly-initialized TrainState for the same model
+        and optimizer; returns it with restored values and step.
+        """
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        template = jax.tree.map(
+            lambda x: ocp.utils.to_shape_dtype_struct(x), _saveable(state)
+        )
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(template)
+        )
+        return dataclasses.replace(
+            state,
+            step=restored["step"],
+            params=restored["params"],
+            batch_stats=restored["batch_stats"],
+            opt_state=restored["opt_state"],
+        )
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def wait(self) -> None:
+        """Block until in-flight async saves land (call before process exit)."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self.wait()
+        self._mgr.close()
+
+
+def latest_step(directory: str) -> int | None:
+    """Latest checkpointed step under ``directory``, or None."""
+    with ocp.CheckpointManager(directory) as mgr:
+        return mgr.latest_step()

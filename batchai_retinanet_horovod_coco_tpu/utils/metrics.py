@@ -1,0 +1,89 @@
+"""Structured training metrics: JSONL always, TensorBoard when available.
+
+Replaces the reference's observability stack (SURVEY.md §5.5): Keras progbar
+per rank + TensorBoard callback + Horovod ``MetricAverageCallback``.  Here
+cross-replica averaging already happened ON DEVICE inside the train step
+(``lax.pmean``, train/step.py), so the logger is a process-0-only sink:
+one JSONL line per log event (machine-readable, the era's TensorBoard
+equivalent for this air-gapped environment) plus optional tf.summary output
+when TensorFlow is importable, plus a human line on stdout.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Mapping
+
+import jax
+import numpy as np
+
+
+def _scalarize(metrics: Mapping[str, Any]) -> dict[str, float]:
+    out = {}
+    for k, v in metrics.items():
+        try:
+            out[k] = float(np.asarray(v))
+        except (TypeError, ValueError):
+            continue
+    return out
+
+
+class MetricLogger:
+    """Process-0 metric sink: JSONL file + stdout + optional TensorBoard."""
+
+    def __init__(
+        self,
+        log_dir: str | None,
+        tensorboard: bool = False,
+        stdout: bool = True,
+        only_process_zero: bool = True,
+    ):
+        self._enabled = (not only_process_zero) or jax.process_index() == 0
+        self._stdout = stdout
+        self._jsonl = None
+        self._tb = None
+        self._t0 = time.time()
+        if not self._enabled:
+            return
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            self._jsonl = open(os.path.join(log_dir, "metrics.jsonl"), "a")
+            if tensorboard:
+                try:
+                    import tensorflow as tf  # heavyweight; only on request
+
+                    self._tb = tf.summary.create_file_writer(
+                        os.path.join(log_dir, "tb")
+                    )
+                except ImportError:
+                    self._tb = None
+
+    def log(self, step: int, metrics: Mapping[str, Any], prefix: str = "train") -> None:
+        if not self._enabled:
+            return
+        scalars = _scalarize(metrics)
+        if self._jsonl:
+            rec = {"step": step, "wall_s": round(time.time() - self._t0, 3)}
+            rec.update({f"{prefix}/{k}": v for k, v in scalars.items()})
+            self._jsonl.write(json.dumps(rec) + "\n")
+            self._jsonl.flush()
+        if self._tb is not None:
+            import tensorflow as tf
+
+            with self._tb.as_default():
+                for k, v in scalars.items():
+                    tf.summary.scalar(f"{prefix}/{k}", v, step=step)
+            self._tb.flush()
+        if self._stdout:
+            parts = " ".join(f"{k}={v:.4g}" for k, v in sorted(scalars.items()))
+            print(f"[{prefix} step {step}] {parts}", flush=True)
+
+    def close(self) -> None:
+        if self._jsonl:
+            self._jsonl.close()
+            self._jsonl = None
+        if self._tb is not None:
+            self._tb.close()
+            self._tb = None

@@ -101,10 +101,12 @@ def main() -> None:
     batch_size = int(os.environ.get("BENCH_BATCH", "8"))
     try:
         ips = run_bench(batch_size)
-    except Exception as e:  # retry smaller before giving up (e.g. HBM OOM)
-        if batch_size <= 2:
+    except Exception as e:
+        # Retry smaller only for HBM exhaustion; real bugs propagate.
+        oom = "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e)
+        if batch_size <= 2 or not oom:
             raise
-        print(f"# batch {batch_size} failed ({type(e).__name__}); retrying at 2", flush=True)
+        print(f"# batch {batch_size} OOM; retrying at 2", flush=True)
         batch_size = 2
         ips = run_bench(batch_size)
 

@@ -1,0 +1,28 @@
+#!/usr/bin/env python
+"""Standalone COCO mAP evaluation CLI.
+
+Parity with keras-retinanet's ``bin/evaluate.py`` (SURVEY.md M12): load a
+snapshot, run the inference path (forward → decode → on-device batched NMS),
+and print COCO mAP@[.5:.95] stats.  Thin shim over ``train.py --eval-only``
+so the two surfaces can never drift.
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main(argv: list[str] | None = None):
+    import train
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    metrics = train.main(argv + ["--eval-only"])
+    names = ("AP", "AP50", "AP75", "APsmall", "APmedium", "APlarge")
+    for k in names:
+        if k in metrics:
+            print(f"{k}: {metrics[k]:.4f}")
+    return metrics
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,74 @@
+"""Checkpoint/resume tests (SURVEY.md §5.4): bit-exact state round trip."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from batchai_retinanet_horovod_coco_tpu.models import RetinaNetConfig, build_retinanet
+from batchai_retinanet_horovod_coco_tpu.train import create_train_state
+from batchai_retinanet_horovod_coco_tpu.utils.checkpoint import (
+    CheckpointManager,
+    latest_step,
+)
+
+
+@pytest.fixture()
+def small_state():
+    model = build_retinanet(
+        RetinaNetConfig(
+            num_classes=2, backbone="resnet_test", fpn_channels=16,
+            head_width=16, head_depth=1, dtype=jnp.float32,
+        )
+    )
+    state = create_train_state(
+        model, optax.sgd(1e-2, momentum=0.9), (1, 64, 64, 3), jax.random.key(0)
+    )
+    return model, state
+
+
+class TestCheckpointRoundTrip:
+    def test_save_restore_bit_exact(self, tmp_path, small_state):
+        model, state = small_state
+        # Mutate so opt_state/step are non-trivial.
+        grads = jax.tree.map(jnp.ones_like, state.params)
+        state = state.apply_gradients(grads)
+
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), save_interval_steps=1)
+        assert mgr.save(state, step=1)
+        mgr.wait()
+        assert mgr.latest_step() == 1
+
+        fresh = create_train_state(
+            model, state.tx, (1, 64, 64, 3), jax.random.key(123)
+        )
+        restored = mgr.restore(fresh)
+        mgr.close()
+
+        assert int(restored.step) == 1
+        jax.tree.map(
+            np.testing.assert_array_equal, restored.params, state.params
+        )
+        jax.tree.map(
+            np.testing.assert_array_equal, restored.opt_state, state.opt_state
+        )
+
+    def test_latest_step_empty_and_missing_restore(self, tmp_path, small_state):
+        _, state = small_state
+        mgr = CheckpointManager(str(tmp_path / "empty"))
+        assert mgr.latest_step() is None
+        with pytest.raises(FileNotFoundError):
+            mgr.restore(state)
+        mgr.close()
+
+    def test_save_interval_respected(self, tmp_path, small_state):
+        _, state = small_state
+        mgr = CheckpointManager(
+            str(tmp_path / "ckpt"), save_interval_steps=10
+        )
+        assert mgr.save(state, step=10)
+        assert not mgr.save(state, step=15)  # off-interval skipped
+        assert mgr.save(state, step=20)
+        mgr.close()
+        assert latest_step(str(tmp_path / "ckpt")) == 20

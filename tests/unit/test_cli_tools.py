@@ -1,0 +1,34 @@
+"""Smoke tests for the auxiliary CLIs (evaluate.py / debug.py, SURVEY.md M12)."""
+
+import sys
+
+import pytest
+
+sys.path.insert(0, "/root/repo")
+
+
+@pytest.mark.slow
+class TestDebugCli:
+    def test_synthetic_report_and_vis(self, tmp_path):
+        import debug
+
+        report = debug.main(
+            [
+                "synthetic",
+                "--synthetic-root", str(tmp_path / "data"),
+                "--synthetic-images", "3",
+                "--synthetic-size", "128",
+                "--limit", "3",
+                "--output-dir", str(tmp_path / "vis"),
+            ]
+        )
+        assert len(report) == 3
+        # Every synthetic image has gt and the matcher must find positives
+        # (force_match_for_gt semantics — a gt with no anchor is a data bug).
+        assert all(r["positive"] > 0 for r in report)
+        assert all(
+            r["positive"] + r["negative"] + r["ignored"] == r["anchors"]
+            for r in report
+        )
+        vis = list((tmp_path / "vis").glob("*.jpg"))
+        assert len(vis) == 3

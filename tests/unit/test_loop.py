@@ -1,0 +1,129 @@
+"""Training-loop tests: stepping, logging, checkpoint-resume mid-run.
+
+The resume test is the §5.3 fault-recovery story: kill a run after N steps,
+restart from the latest checkpoint, and the loop continues from there.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from batchai_retinanet_horovod_coco_tpu.data.pipeline import Batch
+from batchai_retinanet_horovod_coco_tpu.models import RetinaNetConfig, build_retinanet
+from batchai_retinanet_horovod_coco_tpu.parallel import make_mesh
+from batchai_retinanet_horovod_coco_tpu.train import create_train_state
+from batchai_retinanet_horovod_coco_tpu.train.loop import LoopConfig, run_training
+from batchai_retinanet_horovod_coco_tpu.utils.metrics import MetricLogger
+
+HW = (64, 64)
+NUM_CLASSES = 3
+BATCH = 8
+
+
+def tiny_model():
+    return build_retinanet(
+        RetinaNetConfig(
+            num_classes=NUM_CLASSES, backbone="resnet_test", fpn_channels=16,
+            head_width=16, head_depth=1, dtype=jnp.float32,
+        )
+    )
+
+
+def fresh_state(model, seed=0):
+    return create_train_state(
+        model, optax.sgd(1e-3, momentum=0.9), (1, *HW, 3), jax.random.key(seed)
+    )
+
+
+def batch_stream(seed=0):
+    # One fixed batch repeated forever: keeps the resume-parity test exact
+    # (step k sees the same data in the resumed and uninterrupted runs).
+    rng = np.random.default_rng(seed)
+    images = rng.normal(0, 1, (BATCH, *HW, 3)).astype(np.float32)
+    gt_boxes = np.tile(
+        np.array([[8.0, 8.0, 40.0, 40.0]], np.float32), (BATCH, 1, 1)
+    )
+    while True:
+        yield Batch(
+            images=images,
+            gt_boxes=gt_boxes,
+            gt_labels=np.ones((BATCH, 1), np.int32),
+            gt_mask=np.ones((BATCH, 1), bool),
+            image_ids=np.arange(BATCH, dtype=np.int64),
+            scales=np.ones((BATCH,), np.float32),
+            valid=np.ones((BATCH,), bool),
+        )
+
+
+class TestRunTraining:
+    def test_steps_and_jsonl_logging(self, tmp_path):
+        model = tiny_model()
+        logger = MetricLogger(str(tmp_path), stdout=False)
+        state = run_training(
+            model, fresh_state(model), batch_stream(), NUM_CLASSES,
+            LoopConfig(total_steps=4, log_every=2), logger=logger,
+        )
+        logger.close()
+        assert int(state.step) == 4
+        lines = [
+            json.loads(l)
+            for l in (tmp_path / "metrics.jsonl").read_text().splitlines()
+        ]
+        assert [l["step"] for l in lines] == [2, 4]
+        assert all(np.isfinite(l["train/loss"]) for l in lines)
+        assert all("train/images_per_sec" in l for l in lines)
+
+    def test_mesh_loop_runs(self):
+        model = tiny_model()
+        state = run_training(
+            model, fresh_state(model), batch_stream(), NUM_CLASSES,
+            LoopConfig(total_steps=2, log_every=10), mesh=make_mesh(8),
+        )
+        assert int(state.step) == 2
+
+    def test_eval_hook_called(self):
+        calls = []
+
+        def eval_fn(state):
+            calls.append(int(state.step))
+            return {"mAP": 0.0}
+
+        model = tiny_model()
+        run_training(
+            model, fresh_state(model), batch_stream(), NUM_CLASSES,
+            LoopConfig(total_steps=4, log_every=10, eval_every=2),
+            eval_fn=eval_fn,
+        )
+        assert calls == [2, 4]  # mid-run + final (final not duplicated)
+
+    def test_checkpoint_resume_continues(self, tmp_path):
+        model = tiny_model()
+        ckpt_dir = str(tmp_path / "ckpt")
+        cfg = dict(log_every=100, checkpoint_every=1, checkpoint_dir=ckpt_dir)
+
+        # Run 1: 3 steps, then "crash".
+        s1 = run_training(
+            model, fresh_state(model), batch_stream(), NUM_CLASSES,
+            LoopConfig(total_steps=3, **cfg),
+        )
+        # Run 2: fresh state, resumes at 3, continues to 5.
+        s2 = run_training(
+            model, fresh_state(model, seed=99), batch_stream(), NUM_CLASSES,
+            LoopConfig(total_steps=5, **cfg),
+        )
+        assert int(s2.step) == 5
+
+        # Bitwise parity: an uninterrupted 5-step run from the same init and
+        # the same stream yields the resumed run's params exactly (the data
+        # stream here is stateless per step, so resume sees the same batches).
+        s_full = run_training(
+            model, fresh_state(model), batch_stream(), NUM_CLASSES,
+            LoopConfig(total_steps=5, log_every=100),
+        )
+        jax.tree.map(
+            np.testing.assert_array_equal, s2.params, s_full.params
+        )

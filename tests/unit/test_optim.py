@@ -1,0 +1,66 @@
+"""Optimizer/schedule factory tests (reference LR rules, SURVEY.md M11/H1)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from batchai_retinanet_horovod_coco_tpu.train.optim import (
+    OptimizerConfig,
+    make_optimizer,
+    make_schedule,
+    peak_lr,
+)
+
+
+class TestSchedule:
+    def test_linear_scaling_rule_sgd(self):
+        cfg = OptimizerConfig(base_lr=0.01, global_batch_size=256)
+        assert peak_lr(cfg) == pytest.approx(0.01)
+        cfg = OptimizerConfig(base_lr=0.01, global_batch_size=16)
+        assert peak_lr(cfg) == pytest.approx(0.01 / 16)
+
+    def test_adam_world_size_rule(self):
+        # The reference's hvd.size() LR scaling (SURVEY.md call stack 3.2).
+        cfg = OptimizerConfig(optimizer="adam", base_lr=1e-5, world_size=8)
+        assert peak_lr(cfg) == pytest.approx(8e-5)
+
+    def test_warmup_then_multistep(self):
+        cfg = OptimizerConfig(
+            base_lr=0.01,
+            global_batch_size=256,
+            warmup_steps=100,
+            total_steps=1000,
+            milestones=(0.5, 0.9),
+        )
+        s = make_schedule(cfg)
+        assert float(s(0)) == pytest.approx(0.01 / 100, rel=1e-4)
+        assert float(s(100)) == pytest.approx(0.01, rel=1e-4)
+        assert float(s(499)) == pytest.approx(0.01, rel=1e-4)
+        assert float(s(501)) == pytest.approx(0.001, rel=1e-4)
+        assert float(s(901)) == pytest.approx(0.0001, rel=1e-4)
+
+    def test_no_warmup(self):
+        cfg = OptimizerConfig(
+            base_lr=0.01, global_batch_size=256, warmup_steps=0,
+            schedule="constant",
+        )
+        assert float(make_schedule(cfg)(0)) == pytest.approx(0.01)
+
+
+class TestFreezeBackbone:
+    def test_backbone_updates_zeroed(self):
+        cfg = OptimizerConfig(
+            freeze_backbone=True, warmup_steps=0, schedule="constant",
+            global_batch_size=256, weight_decay=0.0,
+        )
+        tx, _ = make_optimizer(cfg)
+        params = {
+            "backbone": {"w": jnp.ones((3,))},
+            "fpn": {"w": jnp.ones((3,))},
+        }
+        grads = jax.tree.map(jnp.ones_like, params)
+        opt_state = tx.init(params)
+        updates, _ = tx.update(grads, opt_state, params)
+        np.testing.assert_array_equal(updates["backbone"]["w"], 0.0)
+        assert float(jnp.abs(updates["fpn"]["w"]).sum()) > 0

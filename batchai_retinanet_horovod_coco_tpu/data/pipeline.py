@@ -71,6 +71,25 @@ class Batch(NamedTuple):
     valid: np.ndarray  # (B,) bool: False for eval padding rows
 
 
+def round_up(x: int, multiple: int) -> int:
+    return ((x + multiple - 1) // multiple) * multiple
+
+
+def default_buckets(min_side: int, max_side: int) -> tuple[tuple[int, int], ...]:
+    """Static (H, W) shape buckets covering the resize rule's output range.
+
+    The single source of truth for bucket derivation — train.py and debug.py
+    both consume this, so the shapes the tools report match the shapes the
+    train step compiles for.
+    """
+    lo = round_up(min_side, 32)
+    hi = round_up(max_side, 32)
+    if lo == hi:
+        return ((lo, lo),)
+    mid = round_up((lo + hi) // 2, 32)
+    return ((lo, hi), (hi, lo), (mid, mid))
+
+
 def resize_scale(h: int, w: int, min_side: int, max_side: int) -> float:
     """Reference resize rule: scale so min side = min_side, capped by max_side."""
     scale = min_side / min(h, w)

@@ -1,0 +1,102 @@
+"""ctypes bindings for the native COCOeval kernels (native/cocoeval.cpp).
+
+``get_kernels()`` returns (iou_matrix, match_detections) numpy-facing
+callables, or None when the native library can't be built/loaded — callers
+keep their pure-numpy path as the fallback and oracle.  Disable explicitly
+with BATCHAI_TPU_NO_NATIVE=1 (used by the parity tests to compare paths).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Callable, NamedTuple
+
+import numpy as np
+
+_i64 = ctypes.POINTER(ctypes.c_int64)
+_f64 = ctypes.POINTER(ctypes.c_double)
+_u8 = ctypes.POINTER(ctypes.c_uint8)
+
+
+class NativeKernels(NamedTuple):
+    iou_matrix: Callable[[np.ndarray, np.ndarray, np.ndarray], np.ndarray]
+    match_detections: Callable[
+        [np.ndarray, np.ndarray, np.ndarray, np.ndarray],
+        tuple[np.ndarray, np.ndarray, np.ndarray],
+    ]
+
+
+_CACHED: tuple[bool, NativeKernels | None] | None = None
+
+
+def _as(arr: np.ndarray, dtype, ptr_type):
+    a = np.ascontiguousarray(arr, dtype=dtype)
+    return a, a.ctypes.data_as(ptr_type)
+
+
+def get_kernels() -> NativeKernels | None:
+    global _CACHED
+    if _CACHED is not None:
+        return _CACHED[1]
+    if os.environ.get("BATCHAI_TPU_NO_NATIVE"):
+        _CACHED = (True, None)
+        return None
+
+    from batchai_retinanet_horovod_coco_tpu.native import load_library
+
+    lib = load_library("cocoeval")
+    if lib is None:
+        _CACHED = (True, None)
+        return None
+
+    lib.iou_matrix_xywh.argtypes = [
+        _f64, ctypes.c_int64, _f64, ctypes.c_int64, _u8, _f64,
+    ]
+    lib.iou_matrix_xywh.restype = None
+    lib.match_detections.argtypes = [
+        _f64, ctypes.c_int64, ctypes.c_int64, _f64, ctypes.c_int64,
+        _u8, _u8, _i64, _i64, _u8,
+    ]
+    lib.match_detections.restype = None
+
+    def iou_matrix(
+        dt: np.ndarray, gt: np.ndarray, iscrowd: np.ndarray
+    ) -> np.ndarray:
+        D, G = len(dt), len(gt)
+        out = np.zeros((D, G), dtype=np.float64)
+        if D and G:
+            dt_a, dt_p = _as(dt, np.float64, _f64)
+            gt_a, gt_p = _as(gt, np.float64, _f64)
+            cr_a, cr_p = _as(iscrowd, np.uint8, _u8)
+            lib.iou_matrix_xywh(
+                dt_p, D, gt_p, G, cr_p, out.ctypes.data_as(_f64)
+            )
+        return out
+
+    def match_detections(
+        ious: np.ndarray,
+        iou_thrs: np.ndarray,
+        g_ignore: np.ndarray,
+        g_crowd: np.ndarray,
+    ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        D, G = ious.shape
+        T = len(iou_thrs)
+        dtm = np.empty((T, D), dtype=np.int64)
+        gtm = np.empty((T, G), dtype=np.int64)
+        dt_ignore = np.empty((T, D), dtype=np.uint8)
+        io_a, io_p = _as(ious, np.float64, _f64)
+        th_a, th_p = _as(iou_thrs, np.float64, _f64)
+        gi_a, gi_p = _as(g_ignore, np.uint8, _u8)
+        gc_a, gc_p = _as(g_crowd, np.uint8, _u8)
+        lib.match_detections(
+            io_p, D, G, th_p, T, gi_p, gc_p,
+            dtm.ctypes.data_as(_i64),
+            gtm.ctypes.data_as(_i64),
+            dt_ignore.ctypes.data_as(_u8),
+        )
+        return dtm, gtm, dt_ignore.astype(bool)
+
+    kernels = NativeKernels(iou_matrix, match_detections)
+    _CACHED = (True, kernels)
+    return kernels

@@ -26,6 +26,8 @@ import dataclasses
 
 import numpy as np
 
+from batchai_retinanet_horovod_coco_tpu.evaluate import _native
+
 
 @dataclasses.dataclass
 class EvalParams:
@@ -56,6 +58,9 @@ def bbox_iou_xywh(dt: np.ndarray, gt: np.ndarray, iscrowd: np.ndarray) -> np.nda
     """
     if len(dt) == 0 or len(gt) == 0:
         return np.zeros((len(dt), len(gt)), dtype=np.float64)
+    kernels = _native.get_kernels()
+    if kernels is not None:
+        return kernels.iou_matrix(dt, gt, iscrowd)
     dx1, dy1 = dt[:, 0], dt[:, 1]
     dx2, dy2 = dt[:, 0] + dt[:, 2], dt[:, 1] + dt[:, 3]
     gx1, gy1 = gt[:, 0], gt[:, 1]
@@ -175,31 +180,39 @@ class CocoEval:
 
         T = len(p.iou_thrs)
         D, G = len(dt), len(gt)
-        gtm = -np.ones((T, G), dtype=np.int64)  # index of matching det
-        dtm = -np.ones((T, D), dtype=np.int64)  # index of matching gt
-        dt_ignore = np.zeros((T, D), dtype=bool)
+        kernels = _native.get_kernels()
+        if kernels is not None and G:
+            iou_thrs = np.asarray(p.iou_thrs, dtype=np.float64)
+            dtm, gtm, dt_ignore = kernels.match_detections(
+                np.ascontiguousarray(ious), iou_thrs, g_ignore, g_crowd
+            )
+        else:
+            gtm = -np.ones((T, G), dtype=np.int64)  # index of matching det
+            dtm = -np.ones((T, D), dtype=np.int64)  # index of matching gt
+            dt_ignore = np.zeros((T, D), dtype=bool)
 
-        for t, thr in enumerate(p.iou_thrs):
-            for dind in range(D):
-                best = min(thr, 1.0 - 1e-10)
-                m = -1
-                for gind in range(G):
-                    # Gt already claimed at this threshold (crowds may rematch).
-                    if gtm[t, gind] >= 0 and not g_crowd[gind]:
+            for t, thr in enumerate(p.iou_thrs):
+                for dind in range(D):
+                    best = min(thr, 1.0 - 1e-10)
+                    m = -1
+                    for gind in range(G):
+                        # Gt already claimed at this threshold (crowds may
+                        # rematch).
+                        if gtm[t, gind] >= 0 and not g_crowd[gind]:
+                            continue
+                        # Gts are sorted ignore-last: once we have a real
+                        # match, stop before the ignore region.
+                        if m > -1 and not g_ignore[m] and g_ignore[gind]:
+                            break
+                        if ious[dind, gind] < best:
+                            continue
+                        best = ious[dind, gind]
+                        m = gind
+                    if m == -1:
                         continue
-                    # Gts are sorted ignore-last: once we have a real match,
-                    # stop before the ignore region.
-                    if m > -1 and not g_ignore[m] and g_ignore[gind]:
-                        break
-                    if ious[dind, gind] < best:
-                        continue
-                    best = ious[dind, gind]
-                    m = gind
-                if m == -1:
-                    continue
-                dtm[t, dind] = m
-                gtm[t, m] = dind
-                dt_ignore[t, dind] = g_ignore[m]
+                    dtm[t, dind] = m
+                    gtm[t, m] = dind
+                    dt_ignore[t, dind] = g_ignore[m]
 
         # Unmatched dets whose own area is outside the range are ignored too.
         d_area = d_boxes[:, 2] * d_boxes[:, 3]

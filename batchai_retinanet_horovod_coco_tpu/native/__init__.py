@@ -1,0 +1,10 @@
+"""Native (C++) host kernels, compiled on demand and bound via ctypes.
+
+SURVEY.md §2.5: the reference's native host code is pycocotools' C and the
+Cython ``compute_overlap``; the rebuild's anchor-side IoU lives ON DEVICE
+(ops/iou.py), and the eval-side hot loop lives here.
+"""
+
+from batchai_retinanet_horovod_coco_tpu.native.build import load_library
+
+__all__ = ["load_library"]

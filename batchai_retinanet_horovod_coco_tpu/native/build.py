@@ -1,0 +1,68 @@
+"""On-demand g++ compilation + ctypes loading of the native kernels.
+
+No pybind11 in this environment (and no Python.h dependency wanted): the
+kernels expose a plain C ABI and are bound with ctypes.  The .so is rebuilt
+whenever the source is newer (mtime) and cached next to the source; if no
+toolchain is available the caller falls back to its pure-numpy path, so the
+framework never hard-requires a compiler at runtime.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+_CACHE: dict[str, ctypes.CDLL | None] = {}
+
+
+def _compile(src: str, lib: str) -> bool:
+    with tempfile.NamedTemporaryFile(
+        suffix=".so", dir=_DIR, delete=False
+    ) as tmp:
+        tmp_path = tmp.name
+    cmd = [
+        "g++", "-O3", "-march=native", "-shared", "-fPIC",
+        # Bit parity with the numpy oracle: no FMA contraction.
+        "-ffp-contract=off",
+        "-o", tmp_path, src,
+    ]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, timeout=120
+        )
+        os.replace(tmp_path, lib)  # atomic under concurrent builders
+        return True
+    except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp_path)
+        except OSError:
+            pass
+        return False
+
+
+def load_library(name: str = "cocoeval") -> ctypes.CDLL | None:
+    """Load (building if stale) ``native/<name>.cpp`` → CDLL, or None."""
+    with _LOCK:
+        if name in _CACHE:
+            return _CACHE[name]
+        src = os.path.join(_DIR, f"{name}.cpp")
+        lib = os.path.join(_DIR, f"lib{name}.so")
+        result: ctypes.CDLL | None = None
+        if os.path.exists(src):
+            # Strict >: a fresh checkout gives .so and .cpp equal mtimes, and
+            # a checked-out binary (wrong ISA, stale) must be rebuilt.
+            fresh = os.path.exists(lib) and os.path.getmtime(
+                lib
+            ) > os.path.getmtime(src)
+            if fresh or _compile(src, lib):
+                try:
+                    result = ctypes.CDLL(lib)
+                except OSError:
+                    result = None
+        _CACHE[name] = result
+        return result

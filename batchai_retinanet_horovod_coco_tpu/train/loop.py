@@ -132,7 +132,9 @@ def run_training(
 
         # ``step`` is tracked host-side (state.step mirrors it) so the loop
         # never forces a per-step device sync on tunneled TPU backends.
-        if step % config.log_every == 0 or step == config.total_steps:
+        if (
+            config.log_every and step % config.log_every == 0
+        ) or step == config.total_steps:
             scalars = {k: v for k, v in jax.device_get(metrics).items()}
             dt = time.perf_counter() - window_t0
             scalars["images_per_sec"] = window_images / max(dt, 1e-9)

@@ -70,9 +70,9 @@ def main(argv=None) -> list[dict]:
             os.path.join(args.coco_path, args.images),
         )
 
-    lo = (args.image_min_side + 31) // 32 * 32
-    hi = (args.image_max_side + 31) // 32 * 32
-    buckets = ((lo, hi), (hi, lo), (lo, lo)) if lo != hi else ((lo, lo),)
+    from batchai_retinanet_horovod_coco_tpu.data.pipeline import default_buckets
+
+    buckets = default_buckets(args.image_min_side, args.image_max_side)
     pipe = build_pipeline(
         dataset,
         PipelineConfig(

@@ -1,0 +1,176 @@
+"""Native C++ COCOeval kernels vs the pure-numpy oracle: bit parity.
+
+The native library (native/cocoeval.cpp) replaces the hot per-(image,
+category) matching loop; these tests force both paths over randomized
+fixtures (incl. crowds, ignores, empty sides) and require IDENTICAL output —
+the numpy path stays the oracle, the C++ path is the shipped fast path.
+"""
+
+import numpy as np
+import pytest
+
+from batchai_retinanet_horovod_coco_tpu.evaluate import _native
+from batchai_retinanet_horovod_coco_tpu.evaluate.coco_eval import (
+    CocoEval,
+    bbox_iou_xywh,
+)
+
+kernels = _native.get_kernels()
+needs_native = pytest.mark.skipif(
+    kernels is None, reason="native toolchain unavailable"
+)
+
+
+def _numpy_iou(dt, gt, iscrowd):
+    """The oracle IoU, inlined (bbox_iou_xywh dispatches to native)."""
+    if len(dt) == 0 or len(gt) == 0:
+        return np.zeros((len(dt), len(gt)), dtype=np.float64)
+    dx2, dy2 = dt[:, 0] + dt[:, 2], dt[:, 1] + dt[:, 3]
+    gx2, gy2 = gt[:, 0] + gt[:, 2], gt[:, 1] + gt[:, 3]
+    iw = np.clip(
+        np.minimum(dx2[:, None], gx2[None, :])
+        - np.maximum(dt[:, 0][:, None], gt[:, 0][None, :]),
+        0.0, None,
+    )
+    ih = np.clip(
+        np.minimum(dy2[:, None], gy2[None, :])
+        - np.maximum(dt[:, 1][:, None], gt[:, 1][None, :]),
+        0.0, None,
+    )
+    inter = iw * ih
+    d_area = (dt[:, 2] * dt[:, 3])[:, None]
+    g_area = (gt[:, 2] * gt[:, 3])[None, :]
+    union = np.where(iscrowd[None, :].astype(bool), d_area, d_area + g_area - inter)
+    return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
+
+
+def _numpy_match(ious, iou_thrs, g_ignore, g_crowd):
+    """The oracle greedy matcher, inlined from coco_eval.py's fallback."""
+    D, G = ious.shape
+    T = len(iou_thrs)
+    gtm = -np.ones((T, G), dtype=np.int64)
+    dtm = -np.ones((T, D), dtype=np.int64)
+    dt_ignore = np.zeros((T, D), dtype=bool)
+    for t, thr in enumerate(iou_thrs):
+        for dind in range(D):
+            best = min(thr, 1.0 - 1e-10)
+            m = -1
+            for gind in range(G):
+                if gtm[t, gind] >= 0 and not g_crowd[gind]:
+                    continue
+                if m > -1 and not g_ignore[m] and g_ignore[gind]:
+                    break
+                if ious[dind, gind] < best:
+                    continue
+                best = ious[dind, gind]
+                m = gind
+            if m == -1:
+                continue
+            dtm[t, dind] = m
+            gtm[t, m] = dind
+            dt_ignore[t, dind] = g_ignore[m]
+    return dtm, gtm, dt_ignore
+
+
+def random_boxes(rng, n):
+    xy = rng.uniform(0, 80, (n, 2))
+    wh = rng.uniform(1, 40, (n, 2))
+    return np.concatenate([xy, wh], axis=1)
+
+
+@needs_native
+class TestIouParity:
+    @pytest.mark.parametrize("seed", range(5))
+    def test_random(self, seed):
+        rng = np.random.default_rng(seed)
+        dt = random_boxes(rng, int(rng.integers(1, 30)))
+        gt = random_boxes(rng, int(rng.integers(1, 20)))
+        crowd = rng.random(len(gt)) < 0.3
+        np.testing.assert_array_equal(
+            kernels.iou_matrix(dt, gt, crowd), _numpy_iou(dt, gt, crowd)
+        )
+
+    def test_empty(self):
+        z = np.zeros((0, 4))
+        assert kernels.iou_matrix(z, z, np.zeros(0, bool)).shape == (0, 0)
+
+    def test_zero_area(self):
+        dt = np.array([[0.0, 0.0, 0.0, 0.0]])
+        gt = np.array([[0.0, 0.0, 0.0, 0.0]])
+        out = kernels.iou_matrix(dt, gt, np.zeros(1, bool))
+        np.testing.assert_array_equal(out, _numpy_iou(dt, gt, np.zeros(1, bool)))
+
+
+@needs_native
+class TestMatchParity:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_random(self, seed):
+        rng = np.random.default_rng(100 + seed)
+        D = int(rng.integers(0, 40))
+        G = int(rng.integers(1, 25))
+        # Quantized IoUs make exact ties common — the hard case for parity.
+        ious = np.round(rng.random((D, G)), 1)
+        g_ignore = rng.random(G) < 0.3
+        g_crowd = g_ignore & (rng.random(G) < 0.5)
+        # Oracle layout: non-ignored gts first.
+        order = np.argsort(g_ignore, kind="stable")
+        ious, g_ignore, g_crowd = ious[:, order], g_ignore[order], g_crowd[order]
+        thrs = np.linspace(0.5, 0.95, 10)
+        n_dtm, n_gtm, n_ign = _numpy_match(ious, thrs, g_ignore, g_crowd)
+        c_dtm, c_gtm, c_ign = kernels.match_detections(
+            ious, thrs, g_ignore, g_crowd
+        )
+        np.testing.assert_array_equal(c_dtm, n_dtm)
+        np.testing.assert_array_equal(c_gtm, n_gtm)
+        np.testing.assert_array_equal(c_ign, n_ign)
+
+
+@needs_native
+class TestEndToEndParity:
+    def test_full_eval_native_vs_numpy(self, monkeypatch):
+        """CocoEval stats identical with the native path forced off/on."""
+        rng = np.random.default_rng(7)
+        gts, dts = [], []
+        ann_id = 1
+        for img in range(1, 9):
+            for _ in range(int(rng.integers(1, 6))):
+                b = random_boxes(rng, 1)[0]
+                gts.append(
+                    {
+                        "id": ann_id, "image_id": img,
+                        "category_id": int(rng.integers(1, 4)),
+                        "bbox": b.tolist(), "area": float(b[2] * b[3]),
+                        "iscrowd": int(rng.random() < 0.15),
+                    }
+                )
+                ann_id += 1
+                # detection near the gt + one random spurious
+                jitter = b + rng.normal(0, 2, 4)
+                jitter[2:] = np.maximum(jitter[2:], 1)
+                dts.append(
+                    {
+                        "image_id": img,
+                        "category_id": gts[-1]["category_id"],
+                        "bbox": jitter.tolist(),
+                        "score": float(rng.random()),
+                    }
+                )
+            spurious = random_boxes(rng, 1)[0]
+            dts.append(
+                {
+                    "image_id": img, "category_id": int(rng.integers(1, 4)),
+                    "bbox": spurious.tolist(), "score": float(rng.random()),
+                }
+            )
+
+        def run():
+            ev = CocoEval(gts, dts, img_ids=list(range(1, 9)))
+            ev.evaluate()
+            ev.accumulate()
+            return ev.summarize()
+
+        native_stats = run()
+        monkeypatch.setattr(_native, "_CACHED", (True, None))
+        numpy_stats = run()
+        np.testing.assert_array_equal(native_stats, numpy_stats)
+        assert native_stats[0] > 0  # sanity: jittered dets yield nonzero mAP

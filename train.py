@@ -56,29 +56,27 @@ PRESETS: dict[str, dict] = {
 }
 
 
-def round_up(x: int, multiple: int) -> int:
-    return ((x + multiple - 1) // multiple) * multiple
-
-
-def default_buckets(min_side: int, max_side: int) -> tuple[tuple[int, int], ...]:
-    """Static (H, W) shape buckets covering the resize rule's output range."""
-    lo = round_up(min_side, 32)
-    hi = round_up(max_side, 32)
-    if lo == hi:
-        return ((lo, lo),)
-    mid = round_up((lo + hi) // 2, 32)
-    return ((lo, hi), (hi, lo), (mid, mid))
+from batchai_retinanet_horovod_coco_tpu.data.pipeline import (  # noqa: E402
+    default_buckets,
+    round_up,
+)
 
 
 def build_parser() -> argparse.ArgumentParser:
+    # allow_abbrev=False: preset-default resolution compares raw argv flag
+    # names against dest names, which only works with unabbreviated flags.
     p = argparse.ArgumentParser(
-        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+        allow_abbrev=False,
     )
     p.add_argument("--preset", choices=sorted(PRESETS), default=None,
                    help="named BASELINE.json config; explicit flags override")
 
     sub = p.add_subparsers(dest="dataset_type", required=True)
-    coco = sub.add_parser("coco", help="train on a COCO-format dataset")
+    coco = sub.add_parser(
+        "coco", help="train on a COCO-format dataset", allow_abbrev=False
+    )
     coco.add_argument("coco_path", help="dataset root")
     coco.add_argument("--train-annotations",
                       default="annotations/instances_train2017.json")
@@ -87,7 +85,8 @@ def build_parser() -> argparse.ArgumentParser:
                       default="annotations/instances_val2017.json")
     coco.add_argument("--val-images", default="val2017")
     synth = sub.add_parser(
-        "synthetic", help="generated dataset (air-gapped dev/CI path)"
+        "synthetic", help="generated dataset (air-gapped dev/CI path)",
+        allow_abbrev=False,
     )
     synth.add_argument("--synthetic-root", default="/tmp/synthetic_coco")
     synth.add_argument("--synthetic-images", type=int, default=64)
@@ -273,6 +272,11 @@ def main(argv=None) -> dict[str, float]:
     )
 
     shard_index, shard_count = shard_info()
+    if args.batch_size % shard_count:
+        raise SystemExit(
+            f"--batch-size {args.batch_size} not divisible by "
+            f"{shard_count} host processes"
+        )
     local_batch = args.batch_size // shard_count
     pipe_common = dict(
         buckets=buckets,

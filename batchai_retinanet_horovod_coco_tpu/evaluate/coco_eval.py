@@ -55,12 +55,23 @@ def bbox_iou_xywh(dt: np.ndarray, gt: np.ndarray, iscrowd: np.ndarray) -> np.nda
     For a crowd gt the denominator is the detection area alone (a detection
     inside a crowd region counts as fully covered).
     Shapes: dt (D, 4), gt (G, 4) → (D, G).
+    Dispatches to the native kernel when available; ``numpy_bbox_iou_xywh``
+    is the oracle fallback (bit-identical, tests/unit/test_native_cocoeval.py).
     """
     if len(dt) == 0 or len(gt) == 0:
         return np.zeros((len(dt), len(gt)), dtype=np.float64)
     kernels = _native.get_kernels()
     if kernels is not None:
         return kernels.iou_matrix(dt, gt, iscrowd)
+    return numpy_bbox_iou_xywh(dt, gt, iscrowd)
+
+
+def numpy_bbox_iou_xywh(
+    dt: np.ndarray, gt: np.ndarray, iscrowd: np.ndarray
+) -> np.ndarray:
+    """The pure-numpy IoU oracle (see ``bbox_iou_xywh``)."""
+    if len(dt) == 0 or len(gt) == 0:
+        return np.zeros((len(dt), len(gt)), dtype=np.float64)
     dx1, dy1 = dt[:, 0], dt[:, 1]
     dx2, dy2 = dt[:, 0] + dt[:, 2], dt[:, 1] + dt[:, 3]
     gx1, gy1 = gt[:, 0], gt[:, 1]
@@ -80,6 +91,49 @@ def bbox_iou_xywh(dt: np.ndarray, gt: np.ndarray, iscrowd: np.ndarray) -> np.nda
     g_area = (gt[:, 2] * gt[:, 3])[None, :]
     union = np.where(iscrowd[None, :].astype(bool), d_area, d_area + g_area - inter)
     return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
+
+
+def numpy_match_detections(
+    ious: np.ndarray,
+    iou_thrs: np.ndarray,
+    g_ignore: np.ndarray,
+    g_crowd: np.ndarray,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """The pure-numpy greedy matcher oracle (COCOeval ``evaluateImg`` core).
+
+    Dets must be score-sorted, gts ignore-sorted (non-ignored first) —
+    the layout ``CocoEval._evaluate_img`` establishes.  Returns
+    (dtm (T, D), gtm (T, G), dt_ignore (T, D)); the native kernel
+    (native/cocoeval.cpp) is bit-identical to this function.
+    """
+    D, G = ious.shape
+    T = len(iou_thrs)
+    gtm = -np.ones((T, G), dtype=np.int64)  # index of matching det
+    dtm = -np.ones((T, D), dtype=np.int64)  # index of matching gt
+    dt_ignore = np.zeros((T, D), dtype=bool)
+
+    for t, thr in enumerate(iou_thrs):
+        for dind in range(D):
+            best = min(thr, 1.0 - 1e-10)
+            m = -1
+            for gind in range(G):
+                # Gt already claimed at this threshold (crowds may rematch).
+                if gtm[t, gind] >= 0 and not g_crowd[gind]:
+                    continue
+                # Gts are sorted ignore-last: once we have a real match,
+                # stop before the ignore region.
+                if m > -1 and not g_ignore[m] and g_ignore[gind]:
+                    break
+                if ious[dind, gind] < best:
+                    continue
+                best = ious[dind, gind]
+                m = gind
+            if m == -1:
+                continue
+            dtm[t, dind] = m
+            gtm[t, m] = dind
+            dt_ignore[t, dind] = g_ignore[m]
+    return dtm, gtm, dt_ignore
 
 
 class CocoEval:
@@ -178,41 +232,18 @@ class CocoEval:
         g_crowd = np.array([bool(g.get("iscrowd", 0)) for g in gt], dtype=bool)
         ious = ious_raw[:, g_order] if len(gt) else ious_raw
 
-        T = len(p.iou_thrs)
         D, G = len(dt), len(gt)
+        iou_thrs = np.asarray(p.iou_thrs, dtype=np.float64)
         kernels = _native.get_kernels()
         if kernels is not None and G:
-            iou_thrs = np.asarray(p.iou_thrs, dtype=np.float64)
             dtm, gtm, dt_ignore = kernels.match_detections(
                 np.ascontiguousarray(ious), iou_thrs, g_ignore, g_crowd
             )
         else:
-            gtm = -np.ones((T, G), dtype=np.int64)  # index of matching det
-            dtm = -np.ones((T, D), dtype=np.int64)  # index of matching gt
-            dt_ignore = np.zeros((T, D), dtype=bool)
-
-            for t, thr in enumerate(p.iou_thrs):
-                for dind in range(D):
-                    best = min(thr, 1.0 - 1e-10)
-                    m = -1
-                    for gind in range(G):
-                        # Gt already claimed at this threshold (crowds may
-                        # rematch).
-                        if gtm[t, gind] >= 0 and not g_crowd[gind]:
-                            continue
-                        # Gts are sorted ignore-last: once we have a real
-                        # match, stop before the ignore region.
-                        if m > -1 and not g_ignore[m] and g_ignore[gind]:
-                            break
-                        if ious[dind, gind] < best:
-                            continue
-                        best = ious[dind, gind]
-                        m = gind
-                    if m == -1:
-                        continue
-                    dtm[t, dind] = m
-                    gtm[t, m] = dind
-                    dt_ignore[t, dind] = g_ignore[m]
+            dtm, gtm, dt_ignore = numpy_match_detections(
+                np.asarray(ious, dtype=np.float64).reshape(D, G),
+                iou_thrs, g_ignore, g_crowd,
+            )
 
         # Unmatched dets whose own area is outside the range are ignored too.
         d_area = d_boxes[:, 2] * d_boxes[:, 3]

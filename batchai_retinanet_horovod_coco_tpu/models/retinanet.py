@@ -19,6 +19,7 @@ import dataclasses
 from typing import Any
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 
 from batchai_retinanet_horovod_coco_tpu.models.fpn import FPN
@@ -64,13 +65,18 @@ class RetinaNet(nn.Module):
         stages = _BACKBONE_STAGES.get(cfg.backbone)
         if stages is None:
             raise ValueError(f"unsupported backbone: {cfg.backbone!r}")
-        features = ResNet(
-            stage_sizes=stages,
-            norm_kind=cfg.norm_kind,
-            dtype=cfg.dtype,
-            name="backbone",
-        )(images, train=train)
-        pyramid = FPN(channels=cfg.fpn_channels, dtype=cfg.dtype, name="fpn")(features)
+        # named_scope: phase labels in profiler traces (SURVEY.md §5.1).
+        with jax.named_scope("backbone"):
+            features = ResNet(
+                stage_sizes=stages,
+                norm_kind=cfg.norm_kind,
+                dtype=cfg.dtype,
+                name="backbone",
+            )(images, train=train)
+        with jax.named_scope("fpn"):
+            pyramid = FPN(
+                channels=cfg.fpn_channels, dtype=cfg.dtype, name="fpn"
+            )(features)
 
         cls_head = ClassificationHead(
             num_classes=cfg.num_classes,
@@ -90,10 +96,11 @@ class RetinaNet(nn.Module):
         )
 
         cls_out, box_out = [], []
-        for level in cfg.anchor.levels:  # P3 → P7, matching anchor order
-            feat = pyramid[f"p{level}"]
-            cls_out.append(cls_head(feat))
-            box_out.append(box_head(feat))
+        with jax.named_scope("heads"):
+            for level in cfg.anchor.levels:  # P3 → P7, matching anchor order
+                feat = pyramid[f"p{level}"]
+                cls_out.append(cls_head(feat))
+                box_out.append(box_head(feat))
 
         return {
             # Losses run in f32; cast once here so downstream ops are f32.

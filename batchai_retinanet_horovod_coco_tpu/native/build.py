@@ -21,27 +21,29 @@ _CACHE: dict[str, ctypes.CDLL | None] = {}
 
 
 def _compile(src: str, lib: str) -> bool:
-    with tempfile.NamedTemporaryFile(
-        suffix=".so", dir=_DIR, delete=False
-    ) as tmp:
-        tmp_path = tmp.name
-    cmd = [
-        "g++", "-O3", "-march=native", "-shared", "-fPIC",
-        # Bit parity with the numpy oracle: no FMA contraction.
-        "-ffp-contract=off",
-        "-o", tmp_path, src,
-    ]
+    tmp_path = None
     try:
-        subprocess.run(
-            cmd, check=True, capture_output=True, timeout=120
-        )
+        with tempfile.NamedTemporaryFile(
+            suffix=".so", dir=_DIR, delete=False
+        ) as tmp:
+            tmp_path = tmp.name
+        # No -march=native: a cached .so may travel to another host (rsync,
+        # docker COPY preserve mtimes) where exotic ISA extensions would
+        # SIGILL with no way to fall back.  -ffp-contract=off keeps bit
+        # parity with the numpy oracle (no FMA contraction).
+        cmd = [
+            "g++", "-O3", "-shared", "-fPIC", "-ffp-contract=off",
+            "-o", tmp_path, src,
+        ]
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp_path, lib)  # atomic under concurrent builders
         return True
     except (OSError, subprocess.SubprocessError):
-        try:
-            os.unlink(tmp_path)
-        except OSError:
-            pass
+        if tmp_path is not None:
+            try:
+                os.unlink(tmp_path)
+            except OSError:
+                pass
         return False
 
 

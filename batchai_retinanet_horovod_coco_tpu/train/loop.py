@@ -41,6 +41,12 @@ class LoopConfig:
     checkpoint_dir: str | None = None
     resume: bool = True  # resume from latest checkpoint if present
     max_to_keep: int = 3
+    # Profiling (SURVEY.md §5.1: jax.profiler replaces the reference's
+    # nothing-beyond-TensorBoard): trace steps [profile_start_step,
+    # profile_start_step + profile_steps) into profile_dir (per host).
+    profile_dir: str | None = None
+    profile_start_step: int = 10
+    profile_steps: int = 5
 
 
 def _device_batch(batch: Batch, mesh: Mesh | None) -> dict[str, Any]:
@@ -105,6 +111,13 @@ def run_training(
     step_fns: dict[tuple[int, int], Callable] = {}
     start_step = int(state.step)
     last_saved: int | None = None
+    # Clamp the profile window into the steps this run will actually take
+    # (otherwise short runs would never produce a trace).
+    prof_start = min(
+        max(config.profile_start_step, start_step + 1),
+        max(start_step + 1, config.total_steps - config.profile_steps + 1),
+    )
+    prof_end = min(config.total_steps, prof_start + config.profile_steps - 1)
     window_t0 = time.perf_counter()
     window_images = 0
     metrics = None
@@ -123,7 +136,12 @@ def run_training(
                 loss_config=loss_config,
                 matching_config=matching_config,
             )
+        if config.profile_dir and step == prof_start:
+            jax.profiler.start_trace(config.profile_dir)
         state, metrics = step_fn(state, _device_batch(batch, mesh))
+        if config.profile_dir and step == prof_end:
+            jax.block_until_ready(metrics)
+            jax.profiler.stop_trace()
         # Global batch size = local batch × process_count (each process
         # feeds its shard of the global batch).
         window_images += batch.images.shape[0] * (

@@ -1,0 +1,106 @@
+"""Worker for the multi-process pod bring-up test (one OS process per 'host').
+
+Run by test_pod_launch.py:  python pod_worker.py <coordinator> <num_procs>
+<proc_id> <out_dir>.  Each process owns 4 virtual CPU devices, joins the
+world via launch/pod.py (the hvd.init/mpirun replacement, SURVEY.md H4),
+feeds ITS shard of a deterministic global batch through the shard_map'd
+train step, and writes final loss + param checksum for cross-process
+comparison.
+"""
+
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+
+def main(coordinator: str, num_processes: int, process_id: int, out_dir: str):
+    from batchai_retinanet_horovod_coco_tpu.data.pipeline import Batch
+    from batchai_retinanet_horovod_coco_tpu.launch import (
+        DistributedConfig,
+        initialize_distributed,
+        shard_info,
+    )
+    from batchai_retinanet_horovod_coco_tpu.models import (
+        RetinaNetConfig,
+        build_retinanet,
+    )
+    from batchai_retinanet_horovod_coco_tpu.parallel import make_mesh
+    from batchai_retinanet_horovod_coco_tpu.train import create_train_state
+    from batchai_retinanet_horovod_coco_tpu.train.loop import (
+        LoopConfig,
+        run_training,
+    )
+
+    initialize_distributed(
+        DistributedConfig(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    )
+    assert jax.process_count() == num_processes
+    assert len(jax.devices()) == 4 * num_processes
+    shard_index, shard_count = shard_info()
+    assert (shard_index, shard_count) == (process_id, num_processes)
+
+    hw = (64, 64)
+    model = build_retinanet(
+        RetinaNetConfig(
+            num_classes=3, backbone="resnet_test", fpn_channels=16,
+            head_width=16, head_depth=1, dtype=np.float32,
+        )
+    )
+    state = create_train_state(
+        model, optax.sgd(1e-2, momentum=0.9), (1, *hw, 3), jax.random.key(0)
+    )
+
+    global_batch = 8
+    local = global_batch // num_processes
+
+    def stream():
+        # Deterministic GLOBAL batch; each process slices its contiguous
+        # shard (make_array_from_process_local_data concatenates in process
+        # order, matching a global array sharded over the device axis).
+        rng = np.random.default_rng(0)
+        images = rng.normal(0, 1, (global_batch, *hw, 3)).astype(np.float32)
+        boxes = np.tile(
+            np.array([[8.0, 8.0, 40.0, 40.0]], np.float32), (global_batch, 1, 1)
+        )
+        sl = slice(process_id * local, (process_id + 1) * local)
+        while True:
+            yield Batch(
+                images=images[sl],
+                gt_boxes=boxes[sl],
+                gt_labels=np.ones((local, 1), np.int32),
+                gt_mask=np.ones((local, 1), bool),
+                image_ids=np.arange(local, dtype=np.int64),
+                scales=np.ones((local,), np.float32),
+                valid=np.ones((local,), bool),
+            )
+
+    mesh = make_mesh()  # all 8 global devices
+    state = run_training(
+        model, state, stream(), 3,
+        LoopConfig(total_steps=3, log_every=0), mesh=mesh,
+    )
+
+    loss_like = float(
+        sum(float(np.sum(np.asarray(x))) for x in jax.tree.leaves(state.params))
+    )
+    with open(os.path.join(out_dir, f"result_{process_id}.json"), "w") as f:
+        json.dump({"param_sum": loss_like, "step": int(state.step)}, f)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])

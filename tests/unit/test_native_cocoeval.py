@@ -12,7 +12,8 @@ import pytest
 from batchai_retinanet_horovod_coco_tpu.evaluate import _native
 from batchai_retinanet_horovod_coco_tpu.evaluate.coco_eval import (
     CocoEval,
-    bbox_iou_xywh,
+    numpy_bbox_iou_xywh,
+    numpy_match_detections,
 )
 
 kernels = _native.get_kernels()
@@ -20,56 +21,10 @@ needs_native = pytest.mark.skipif(
     kernels is None, reason="native toolchain unavailable"
 )
 
-
-def _numpy_iou(dt, gt, iscrowd):
-    """The oracle IoU, inlined (bbox_iou_xywh dispatches to native)."""
-    if len(dt) == 0 or len(gt) == 0:
-        return np.zeros((len(dt), len(gt)), dtype=np.float64)
-    dx2, dy2 = dt[:, 0] + dt[:, 2], dt[:, 1] + dt[:, 3]
-    gx2, gy2 = gt[:, 0] + gt[:, 2], gt[:, 1] + gt[:, 3]
-    iw = np.clip(
-        np.minimum(dx2[:, None], gx2[None, :])
-        - np.maximum(dt[:, 0][:, None], gt[:, 0][None, :]),
-        0.0, None,
-    )
-    ih = np.clip(
-        np.minimum(dy2[:, None], gy2[None, :])
-        - np.maximum(dt[:, 1][:, None], gt[:, 1][None, :]),
-        0.0, None,
-    )
-    inter = iw * ih
-    d_area = (dt[:, 2] * dt[:, 3])[:, None]
-    g_area = (gt[:, 2] * gt[:, 3])[None, :]
-    union = np.where(iscrowd[None, :].astype(bool), d_area, d_area + g_area - inter)
-    return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
-
-
-def _numpy_match(ious, iou_thrs, g_ignore, g_crowd):
-    """The oracle greedy matcher, inlined from coco_eval.py's fallback."""
-    D, G = ious.shape
-    T = len(iou_thrs)
-    gtm = -np.ones((T, G), dtype=np.int64)
-    dtm = -np.ones((T, D), dtype=np.int64)
-    dt_ignore = np.zeros((T, D), dtype=bool)
-    for t, thr in enumerate(iou_thrs):
-        for dind in range(D):
-            best = min(thr, 1.0 - 1e-10)
-            m = -1
-            for gind in range(G):
-                if gtm[t, gind] >= 0 and not g_crowd[gind]:
-                    continue
-                if m > -1 and not g_ignore[m] and g_ignore[gind]:
-                    break
-                if ious[dind, gind] < best:
-                    continue
-                best = ious[dind, gind]
-                m = gind
-            if m == -1:
-                continue
-            dtm[t, dind] = m
-            gtm[t, m] = dind
-            dt_ignore[t, dind] = g_ignore[m]
-    return dtm, gtm, dt_ignore
+# The SHIPPED numpy fallbacks are the oracles here — no inlined copies, so
+# an oracle change automatically re-tests the native kernel against it.
+_numpy_iou = numpy_bbox_iou_xywh
+_numpy_match = numpy_match_detections
 
 
 def random_boxes(rng, n):

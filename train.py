@@ -58,7 +58,6 @@ PRESETS: dict[str, dict] = {
 
 from batchai_retinanet_horovod_coco_tpu.data.pipeline import (  # noqa: E402
     default_buckets,
-    round_up,
 )
 
 
@@ -133,6 +132,8 @@ def build_parser() -> argparse.ArgumentParser:
         g.add_argument("--log-every", type=int, default=20)
         g.add_argument("--log-dir", default=None)
         g.add_argument("--tensorboard", action="store_true")
+        g.add_argument("--profile-dir", default=None,
+                       help="write a jax.profiler trace of a few steps here")
         g.add_argument("--eval-only", action="store_true")
         g.add_argument("--score-threshold", type=float, default=0.05)
         g.add_argument("--nms-threshold", type=float, default=0.5)
@@ -346,6 +347,7 @@ def main(argv=None) -> dict[str, float]:
             eval_every=args.eval_every,
             checkpoint_dir=args.snapshot_path,
             resume=not args.no_resume,
+            profile_dir=args.profile_dir,
         ),
         mesh=mesh,
         schedule=schedule,

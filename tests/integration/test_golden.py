@@ -11,6 +11,20 @@ Regenerate (only for an INTENDED numerics change) with:
   python -m tests.integration.test_golden
 """
 
+if __name__ == "__main__":
+    # Regeneration must run on the same backend the pytest assertion uses
+    # (conftest.py forces CPU only under pytest; bare python would pick the
+    # container's TPU backend and record wrong goldens).
+    import os
+
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -1,0 +1,122 @@
+"""Pretrained-backbone import tests (torch resnet50 layout → flax tree)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from batchai_retinanet_horovod_coco_tpu.models.import_weights import (
+    apply_backbone_weights,
+    convert_torch_resnet50,
+)
+from batchai_retinanet_horovod_coco_tpu.models.resnet import ResNet
+
+
+def fake_torch_resnet50_sd(rng) -> dict[str, np.ndarray]:
+    """Random arrays in torchvision resnet50 names/shapes (incl. fc, ignored)."""
+    sd = {"conv1.weight": rng.normal(0, 1, (64, 3, 7, 7)).astype(np.float32)}
+
+    def bn(prefix, c):
+        sd[f"{prefix}.weight"] = rng.normal(1, 0.1, c).astype(np.float32)
+        sd[f"{prefix}.bias"] = rng.normal(0, 0.1, c).astype(np.float32)
+        sd[f"{prefix}.running_mean"] = rng.normal(0, 0.1, c).astype(np.float32)
+        sd[f"{prefix}.running_var"] = rng.uniform(0.5, 1.5, c).astype(np.float32)
+
+    bn("bn1", 64)
+    in_c = 64
+    for i, (blocks, width) in enumerate(
+        [(3, 64), (4, 128), (6, 256), (3, 512)], start=1
+    ):
+        for b in range(blocks):
+            p = f"layer{i}.{b}"
+            sd[f"{p}.conv1.weight"] = rng.normal(
+                0, 0.05, (width, in_c, 1, 1)
+            ).astype(np.float32)
+            bn(f"{p}.bn1", width)
+            sd[f"{p}.conv2.weight"] = rng.normal(
+                0, 0.05, (width, width, 3, 3)
+            ).astype(np.float32)
+            bn(f"{p}.bn2", width)
+            sd[f"{p}.conv3.weight"] = rng.normal(
+                0, 0.05, (width * 4, width, 1, 1)
+            ).astype(np.float32)
+            bn(f"{p}.bn3", width * 4)
+            if b == 0:
+                sd[f"{p}.downsample.0.weight"] = rng.normal(
+                    0, 0.05, (width * 4, in_c, 1, 1)
+                ).astype(np.float32)
+                bn(f"{p}.downsample.1", width * 4)
+                in_c = width * 4
+        sd["fc.weight"] = rng.normal(0, 0.05, (1000, 2048)).astype(np.float32)
+        sd["fc.bias"] = np.zeros(1000, np.float32)
+    return sd
+
+
+class TestImport:
+    def test_convert_and_apply_frozen_bn(self):
+        rng = np.random.default_rng(0)
+        sd = fake_torch_resnet50_sd(rng)
+        imp_params, imp_stats = convert_torch_resnet50(sd)
+
+        model = ResNet(stage_sizes=(3, 4, 6, 3), norm_kind="frozen_bn",
+                       dtype=jnp.float32)
+        variables = jax.jit(model.init)(
+            jax.random.key(0), jnp.zeros((1, 64, 64, 3), jnp.float32)
+        )
+        params, stats = apply_backbone_weights(
+            {"backbone": variables["params"]},
+            {"backbone": variables["batch_stats"]},
+            imp_params,
+            imp_stats,
+        )
+        # Spot checks: OIHW→HWIO transpose and BN stat placement.
+        np.testing.assert_allclose(
+            params["backbone"]["stem_conv"]["kernel"],
+            np.transpose(sd["conv1.weight"], (2, 3, 1, 0)),
+        )
+        np.testing.assert_allclose(
+            params["backbone"]["stage3_block1"]["conv2"]["kernel"],
+            np.transpose(sd["layer2.1.conv2.weight"], (2, 3, 1, 0)),
+        )
+        np.testing.assert_allclose(
+            stats["backbone"]["stage5_block0"]["proj_norm"]["var"],
+            sd["layer4.0.downsample.1.running_var"],
+        )
+        # The merged tree still runs.
+        out = model.apply(
+            {"params": params["backbone"], "batch_stats": stats["backbone"]},
+            jnp.ones((1, 64, 64, 3)),
+            train=False,
+        )
+        assert set(out) == {"c3", "c4", "c5"}
+        assert np.isfinite(float(jnp.sum(out["c5"].astype(jnp.float32))))
+
+    def test_shape_mismatch_raises(self):
+        rng = np.random.default_rng(1)
+        sd = fake_torch_resnet50_sd(rng)
+        sd["conv1.weight"] = sd["conv1.weight"][:, :1]  # corrupt
+        imp_params, imp_stats = convert_torch_resnet50(sd)
+        model = ResNet(stage_sizes=(3, 4, 6, 3), norm_kind="frozen_bn",
+                       dtype=jnp.float32)
+        variables = jax.jit(model.init)(
+            jax.random.key(0), jnp.zeros((1, 64, 64, 3), jnp.float32)
+        )
+        with pytest.raises(ValueError, match="shape mismatch"):
+            apply_backbone_weights(
+                {"backbone": variables["params"]},
+                {"backbone": variables["batch_stats"]},
+                imp_params,
+                imp_stats,
+            )
+
+    def test_gn_model_rejects_bn_stats(self):
+        rng = np.random.default_rng(2)
+        sd = fake_torch_resnet50_sd(rng)
+        imp_params, imp_stats = convert_torch_resnet50(sd)
+        with pytest.raises(ValueError, match="BN stats"):
+            apply_backbone_weights(
+                {"backbone": {"stem_conv": {"kernel": np.zeros((7, 7, 3, 64))}}},
+                {},
+                {"stem_conv": {"kernel": np.zeros((7, 7, 3, 64))}},
+                imp_stats,
+            )

@@ -104,6 +104,9 @@ def build_parser() -> argparse.ArgumentParser:
         g.add_argument("--f32", action="store_true",
                        help="compute in float32 (default bfloat16)")
         g.add_argument("--freeze-backbone", action="store_true")
+        g.add_argument("--pretrained-backbone", default=None,
+                       help="torch resnet50 state dict (.pth/.npz) to import; "
+                            "use with --norm frozen_bn (the reference recipe)")
 
         g = sp.add_argument_group("data")
         g.add_argument("--batch-size", type=int, default=16,
@@ -271,6 +274,21 @@ def main(argv=None) -> dict[str, float]:
     state = create_train_state(
         model, tx, (1, *init_hw, 3), jax.random.key(args.seed)
     )
+    if args.pretrained_backbone:
+        from batchai_retinanet_horovod_coco_tpu.models.import_weights import (
+            apply_backbone_weights,
+            convert_torch_resnet50,
+            load_state_dict,
+        )
+
+        imp_params, imp_stats = convert_torch_resnet50(
+            load_state_dict(args.pretrained_backbone)
+        )
+        new_params, new_stats = apply_backbone_weights(
+            state.params, state.batch_stats, imp_params, imp_stats
+        )
+        state = state.replace(params=new_params, batch_stats=new_stats)
+        print(f"imported backbone weights from {args.pretrained_backbone}")
 
     shard_index, shard_count = shard_info()
     if args.batch_size % shard_count:

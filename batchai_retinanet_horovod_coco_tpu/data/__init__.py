@@ -22,12 +22,14 @@ from batchai_retinanet_horovod_coco_tpu.data.pipeline import (
     build_pipeline,
 )
 from batchai_retinanet_horovod_coco_tpu.data.synthetic import make_synthetic_coco
+from batchai_retinanet_horovod_coco_tpu.data.transforms import TransformConfig
 
 __all__ = [
     "Batch",
     "CocoDataset",
     "ImageRecord",
     "PipelineConfig",
+    "TransformConfig",
     "build_pipeline",
     "make_synthetic_coco",
 ]

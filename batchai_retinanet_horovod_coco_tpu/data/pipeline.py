@@ -36,6 +36,10 @@ from typing import NamedTuple
 import numpy as np
 
 from batchai_retinanet_horovod_coco_tpu.data.coco import CocoDataset, ImageRecord
+from batchai_retinanet_horovod_coco_tpu.data.transforms import (
+    TransformConfig,
+    apply_random_transform,
+)
 
 IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], dtype=np.float32)
 IMAGENET_STD = np.array([0.229, 0.224, 0.225], dtype=np.float32)
@@ -51,6 +55,10 @@ class PipelineConfig:
     max_side: int = 1333
     max_gt: int = 100
     hflip_prob: float = 0.5
+    # Full random-affine + photometric augmentation (the reference's
+    # --random-transform recipe, data/transforms.py). When set, it replaces
+    # the flip-only path — configure flips via TransformConfig.flip_x_prob.
+    transform: TransformConfig | None = None
     shuffle: bool = True
     seed: int = 0
     # Multi-host sharding: this process sees records[shard_index::shard_count].
@@ -130,7 +138,11 @@ def load_example(
     labels = record.labels.copy()
     h, w = image.shape[:2]
 
-    if rng is not None and config.hflip_prob > 0 and rng.random() < config.hflip_prob:
+    if rng is not None and config.transform is not None:
+        image, boxes, labels = apply_random_transform(
+            image, boxes, labels, config.transform, rng
+        )
+    elif rng is not None and config.hflip_prob > 0 and rng.random() < config.hflip_prob:
         image = image[:, ::-1]
         x1 = boxes[:, 0].copy()
         boxes[:, 0] = w - boxes[:, 2]

@@ -1,0 +1,171 @@
+"""Random geometric + photometric augmentation (host-side).
+
+Parity target: keras-retinanet's ``utils/transform.py`` random affine
+generator and ``utils/image.py`` visual effects (SURVEY.md M8,
+``random_transform_group_entry``): a homogeneous 3x3 affine composed of
+rotation, translation, shear, scaling, and axis flips — applied about the
+image center, with the translation expressed as a fraction of the image size
+— plus brightness/contrast/saturation jitter.  The reference enabled this
+with its ``--random-transform`` flag; flip-only is the default recipe.
+
+This runs on host CPU inside the data-loader workers (numpy + cv2/PIL), like
+the reference; the TPU never sees it.  Boxes are transformed by mapping all
+four corners and taking the axis-aligned bounding box, then clipped to the
+image; boxes that degenerate (< 1px on a side) are dropped — the analogue of
+the reference generator's invalid-annotation filtering.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformConfig:
+    """Ranges for the random affine + photometric jitter.
+
+    Defaults mirror the reference's ``random_transform_generator`` ranges:
+    rotation/shear in radians, translation as a fraction of the image size,
+    scaling as multiplicative factors.
+    """
+
+    rotation: tuple[float, float] = (-0.1, 0.1)
+    translation: tuple[float, float] = (-0.1, 0.1)
+    shear: tuple[float, float] = (-0.1, 0.1)
+    scaling: tuple[float, float] = (0.9, 1.1)
+    flip_x_prob: float = 0.5
+    flip_y_prob: float = 0.0
+    # Photometric ("visual effect") jitter; identity ranges disable a term.
+    brightness: tuple[float, float] = (-0.1, 0.1)  # additive, fraction of 255
+    contrast: tuple[float, float] = (0.9, 1.1)
+    saturation: tuple[float, float] = (0.95, 1.05)
+
+
+def _rotation(theta: float) -> np.ndarray:
+    c, s = np.cos(theta), np.sin(theta)
+    return np.array([[c, -s, 0.0], [s, c, 0.0], [0.0, 0.0, 1.0]])
+
+
+def _translation(tx: float, ty: float) -> np.ndarray:
+    return np.array([[1.0, 0.0, tx], [0.0, 1.0, ty], [0.0, 0.0, 1.0]])
+
+
+def _shear(angle: float) -> np.ndarray:
+    return np.array(
+        [[1.0, -np.sin(angle), 0.0], [0.0, np.cos(angle), 0.0], [0.0, 0.0, 1.0]]
+    )
+
+
+def _scaling(sx: float, sy: float) -> np.ndarray:
+    return np.diag([sx, sy, 1.0])
+
+
+def random_transform_matrix(
+    config: TransformConfig, rng: np.random.Generator, height: int, width: int
+) -> np.ndarray:
+    """Sample one 3x3 affine in PIXEL coordinates, centered on the image.
+
+    Composition order matches the reference: rotation @ translation @ shear @
+    scaling @ flip, with translation scaled by (width, height) and the whole
+    transform conjugated so its origin is the image center.
+    """
+    u = lambda lo_hi: float(rng.uniform(*lo_hi))  # noqa: E731
+    m = _rotation(u(config.rotation))
+    m = m @ _translation(
+        u(config.translation) * width, u(config.translation) * height
+    )
+    m = m @ _shear(u(config.shear))
+    m = m @ _scaling(u(config.scaling), u(config.scaling))
+    flip_x = rng.random() < config.flip_x_prob
+    flip_y = rng.random() < config.flip_y_prob
+    m = m @ _scaling(-1.0 if flip_x else 1.0, -1.0 if flip_y else 1.0)
+    center = _translation(width / 2.0, height / 2.0)
+    return center @ m @ np.linalg.inv(center)
+
+
+def warp_image(image: np.ndarray, matrix: np.ndarray) -> np.ndarray:
+    """Apply a 3x3 affine to a uint8 HWC image, same output size."""
+    h, w = image.shape[:2]
+    try:
+        import cv2
+
+        return cv2.warpAffine(
+            image,
+            matrix[:2].astype(np.float64),
+            (w, h),
+            flags=cv2.INTER_LINEAR,
+            borderMode=cv2.BORDER_CONSTANT,
+        )
+    except ImportError:
+        from PIL import Image
+
+        inv = np.linalg.inv(matrix)  # PIL wants the output→input mapping
+        coeffs = inv[:2].reshape(-1).tolist()
+        return np.asarray(
+            Image.fromarray(image).transform(
+                (w, h), Image.AFFINE, coeffs, resample=Image.BILINEAR
+            )
+        )
+
+
+def transform_boxes(
+    boxes: np.ndarray, matrix: np.ndarray, height: int, width: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Map corner boxes through an affine; AABB of the 4 corners, clipped.
+
+    Returns (boxes, keep) where ``keep`` marks boxes still ≥1px on both
+    sides after clipping.
+    """
+    if len(boxes) == 0:
+        return boxes, np.zeros((0,), dtype=bool)
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    corners = np.stack(
+        [
+            np.stack([x1, y1], axis=1),
+            np.stack([x2, y1], axis=1),
+            np.stack([x1, y2], axis=1),
+            np.stack([x2, y2], axis=1),
+        ],
+        axis=1,
+    )  # (N, 4, 2)
+    ones = np.ones((*corners.shape[:2], 1))
+    mapped = np.concatenate([corners, ones], axis=2) @ matrix.T  # (N, 4, 3)
+    xs, ys = mapped[..., 0], mapped[..., 1]
+    out = np.stack(
+        [xs.min(axis=1), ys.min(axis=1), xs.max(axis=1), ys.max(axis=1)], axis=1
+    ).astype(np.float32)
+    out[:, 0::2] = np.clip(out[:, 0::2], 0, width)
+    out[:, 1::2] = np.clip(out[:, 1::2], 0, height)
+    keep = ((out[:, 2] - out[:, 0]) >= 1.0) & ((out[:, 3] - out[:, 1]) >= 1.0)
+    return out, keep
+
+
+def apply_visual_effects(
+    image: np.ndarray, config: TransformConfig, rng: np.random.Generator
+) -> np.ndarray:
+    """Brightness/contrast/saturation jitter on a uint8 HWC image."""
+    x = image.astype(np.float32)
+    x = x + float(rng.uniform(*config.brightness)) * 255.0
+    mean = x.mean()
+    x = mean + (x - mean) * float(rng.uniform(*config.contrast))
+    gray = x.mean(axis=2, keepdims=True)
+    x = gray + (x - gray) * float(rng.uniform(*config.saturation))
+    return np.clip(x, 0, 255).astype(np.uint8)
+
+
+def apply_random_transform(
+    image: np.ndarray,
+    boxes: np.ndarray,
+    labels: np.ndarray,
+    config: TransformConfig,
+    rng: np.random.Generator,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """One full augmentation draw: affine warp + box remap + photometric."""
+    h, w = image.shape[:2]
+    matrix = random_transform_matrix(config, rng, h, w)
+    image = warp_image(image, matrix)
+    boxes, keep = transform_boxes(boxes, matrix, h, w)
+    image = apply_visual_effects(image, config, rng)
+    return image, boxes[keep], labels[keep]

@@ -39,9 +39,13 @@ def load_state_dict(path: str) -> dict[str, np.ndarray]:
             return {k: z[k] for k in z.files}
     import torch
 
-    sd = torch.load(path, map_location="cpu", weights_only=True)
-    if hasattr(sd, "state_dict"):
-        sd = sd.state_dict()
+    try:
+        sd = torch.load(path, map_location="cpu", weights_only=True)
+    except Exception as e:
+        raise ValueError(
+            f"{path} is not a plain tensor state dict (full pickled modules "
+            "are not supported; save model.state_dict() instead)"
+        ) from e
     return {k: v.numpy() for k, v in sd.items()}
 
 
@@ -106,12 +110,12 @@ def convert_torch_resnet50(
     return params, batch_stats
 
 
-def _merge(dst: dict, src: Mapping, path: str, dtypes) -> None:
+def _merge(dst: dict, src: Mapping, path: str) -> None:
     for k, v in src.items():
         if k not in dst:
             raise ValueError(f"unknown param {path}/{k} in imported weights")
         if isinstance(v, Mapping):
-            _merge(dst[k], v, f"{path}/{k}", dtypes)
+            _merge(dst[k], v, f"{path}/{k}")
         else:
             if tuple(dst[k].shape) != tuple(np.shape(v)):
                 raise ValueError(
@@ -119,6 +123,17 @@ def _merge(dst: dict, src: Mapping, path: str, dtypes) -> None:
                     f"vs imported {np.shape(v)}"
                 )
             dst[k] = np.asarray(v, dtype=np.asarray(dst[k]).dtype)
+
+
+def _uncovered(dst: Mapping, src: Mapping, path: str) -> list[str]:
+    """Leaves of ``dst`` that ``src`` does not provide (src keys ⊆ dst keys)."""
+    missing: list[str] = []
+    for k, v in dst.items():
+        if k not in src:
+            missing.append(f"{path}/{k}")
+        elif isinstance(v, Mapping):
+            missing.extend(_uncovered(v, src[k], f"{path}/{k}"))
+    return missing
 
 
 def apply_backbone_weights(
@@ -131,8 +146,10 @@ def apply_backbone_weights(
 
     ``params``/``batch_stats`` are the model's initialized variable trees
     (must contain a ``backbone`` entry; frozen_bn/bn models also in
-    batch_stats).  Shape mismatches raise — silently dropping a misnamed
-    tensor is how pretrained imports rot.
+    batch_stats).  Shape mismatches raise, and so does PARTIAL coverage of
+    the backbone (e.g. a resnet50 dict into a resnet101 model, whose extra
+    stage4 blocks would otherwise stay silently random) — silently dropping
+    or skipping tensors is how pretrained imports rot.
     """
     import jax
 
@@ -140,12 +157,21 @@ def apply_backbone_weights(
     new_stats = jax.tree.map(np.asarray, batch_stats)
     if "backbone" not in new_params:
         raise ValueError("model params have no 'backbone' subtree")
-    _merge(new_params["backbone"], imported_params, "backbone", None)
+    _merge(new_params["backbone"], imported_params, "backbone")
+    missing = _uncovered(new_params["backbone"], imported_params, "backbone")
     if imported_stats:
         if "backbone" not in new_stats:
             raise ValueError(
                 "imported weights carry BN stats but the model has none "
                 "(use norm_kind='frozen_bn' or 'bn')"
             )
-        _merge(new_stats["backbone"], imported_stats, "backbone", None)
+        _merge(new_stats["backbone"], imported_stats, "backbone")
+        missing += _uncovered(new_stats["backbone"], imported_stats, "backbone")
+    if missing:
+        head = ", ".join(missing[:5])
+        raise ValueError(
+            f"imported weights leave {len(missing)} backbone leaves "
+            f"uninitialized (model deeper than the checkpoint?): {head}"
+            + ("..." if len(missing) > 5 else "")
+        )
     return new_params, new_stats

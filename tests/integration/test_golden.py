@@ -17,10 +17,11 @@ if __name__ == "__main__":
     # container's TPU backend and record wrong goldens).
     import os
 
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=8"
-    ).strip()
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
     import jax
 
     jax.config.update("jax_platforms", "cpu")

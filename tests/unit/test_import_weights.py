@@ -47,8 +47,8 @@ def fake_torch_resnet50_sd(rng) -> dict[str, np.ndarray]:
                 ).astype(np.float32)
                 bn(f"{p}.downsample.1", width * 4)
                 in_c = width * 4
-        sd["fc.weight"] = rng.normal(0, 0.05, (1000, 2048)).astype(np.float32)
-        sd["fc.bias"] = np.zeros(1000, np.float32)
+    sd["fc.weight"] = rng.normal(0, 0.05, (1000, 2048)).astype(np.float32)
+    sd["fc.bias"] = np.zeros(1000, np.float32)
     return sd
 
 
@@ -102,6 +102,24 @@ class TestImport:
             jax.random.key(0), jnp.zeros((1, 64, 64, 3), jnp.float32)
         )
         with pytest.raises(ValueError, match="shape mismatch"):
+            apply_backbone_weights(
+                {"backbone": variables["params"]},
+                {"backbone": variables["batch_stats"]},
+                imp_params,
+                imp_stats,
+            )
+
+    def test_partial_coverage_raises(self):
+        """A resnet50 dict must NOT silently half-initialize a deeper model."""
+        rng = np.random.default_rng(3)
+        sd = fake_torch_resnet50_sd(rng)
+        imp_params, imp_stats = convert_torch_resnet50(sd)
+        model = ResNet(stage_sizes=(3, 4, 23, 3), norm_kind="frozen_bn",
+                       dtype=jnp.float32)  # resnet101: extra stage4 blocks
+        variables = jax.jit(model.init)(
+            jax.random.key(0), jnp.zeros((1, 64, 64, 3), jnp.float32)
+        )
+        with pytest.raises(ValueError, match="uninitialized"):
             apply_backbone_weights(
                 {"backbone": variables["params"]},
                 {"backbone": variables["batch_stats"]},

@@ -1,0 +1,156 @@
+"""Random affine + photometric augmentation (data/transforms.py).
+
+Oracle style per SURVEY.md §4: exact-value assertions on tiny hand-built
+fixtures — identity transforms, pure flips, exact 90-degree rotations —
+mirroring keras-retinanet's tests/utils/test_transform.py coverage.
+"""
+
+import numpy as np
+import pytest
+
+from batchai_retinanet_horovod_coco_tpu.data.transforms import (
+    TransformConfig,
+    apply_random_transform,
+    random_transform_matrix,
+    transform_boxes,
+    warp_image,
+)
+
+IDENTITY = TransformConfig(
+    rotation=(0, 0),
+    translation=(0, 0),
+    shear=(0, 0),
+    scaling=(1, 1),
+    flip_x_prob=0.0,
+    flip_y_prob=0.0,
+    brightness=(0, 0),
+    contrast=(1, 1),
+    saturation=(1, 1),
+)
+
+
+def test_identity_transform_is_noop():
+    rng = np.random.default_rng(0)
+    image = rng.integers(0, 255, (32, 48, 3), dtype=np.uint8)
+    boxes = np.array([[4.0, 6.0, 20.0, 28.0]], np.float32)
+    labels = np.array([2], np.int32)
+    out_img, out_boxes, out_labels = apply_random_transform(
+        image, boxes, labels, IDENTITY, rng
+    )
+    np.testing.assert_array_equal(out_img, image)
+    np.testing.assert_allclose(out_boxes, boxes, atol=1e-5)
+    np.testing.assert_array_equal(out_labels, labels)
+
+
+def test_flip_x_matches_manual_flip():
+    cfg = TransformConfig(
+        rotation=(0, 0), translation=(0, 0), shear=(0, 0), scaling=(1, 1),
+        flip_x_prob=1.0, flip_y_prob=0.0,
+        brightness=(0, 0), contrast=(1, 1), saturation=(1, 1),
+    )
+    rng = np.random.default_rng(1)
+    h, w = 16, 24
+    m = random_transform_matrix(cfg, rng, h, w)
+    boxes = np.array([[2.0, 3.0, 10.0, 12.0]], np.float32)
+    out, keep = transform_boxes(boxes, m, h, w)
+    assert keep.all()
+    np.testing.assert_allclose(out, [[w - 10.0, 3.0, w - 2.0, 12.0]], atol=1e-5)
+
+    image = np.zeros((h, w, 3), np.uint8)
+    image[:, :4] = 255  # left stripe
+    flipped = warp_image(image, m)
+    # Stripe moves to the right edge (allow 1px interpolation slack).
+    assert flipped[:, -2:].mean() > 200
+    assert flipped[:, :2].mean() < 50
+
+
+def test_rotation_90deg_box_mapping():
+    """Exact 90° rotation about the center of a square image."""
+    cfg = TransformConfig(
+        rotation=(np.pi / 2, np.pi / 2), translation=(0, 0), shear=(0, 0),
+        scaling=(1, 1), flip_x_prob=0.0, flip_y_prob=0.0,
+    )
+    h = w = 20
+    m = random_transform_matrix(cfg, np.random.default_rng(0), h, w)
+    # Point (15, 10) — right of center — rotates to (10, 15) (below center).
+    p = m @ np.array([15.0, 10.0, 1.0])
+    np.testing.assert_allclose(p[:2], [10.0, 15.0], atol=1e-6)
+    boxes = np.array([[12.0, 8.0, 18.0, 12.0]], np.float32)
+    out, keep = transform_boxes(boxes, m, h, w)
+    assert keep.all()
+    np.testing.assert_allclose(out, [[8.0, 12.0, 12.0, 18.0]], atol=1e-5)
+
+
+def test_degenerate_boxes_dropped():
+    # Translate far right: the box is pushed outside and clips to nothing.
+    m = np.array([[1.0, 0.0, 100.0], [0.0, 1.0, 0.0], [0.0, 0.0, 1.0]])
+    boxes = np.array([[2.0, 2.0, 8.0, 8.0]], np.float32)
+    out, keep = transform_boxes(boxes, m, 20, 20)
+    assert not keep.any()
+
+    rng = np.random.default_rng(0)
+    cfg = TransformConfig(
+        rotation=(0, 0), translation=(5.0, 5.0), shear=(0, 0), scaling=(1, 1),
+        flip_x_prob=0.0, brightness=(0, 0), contrast=(1, 1), saturation=(1, 1),
+    )
+    image = np.zeros((20, 20, 3), np.uint8)
+    _, out_boxes, out_labels = apply_random_transform(
+        image, boxes, np.array([1], np.int32), cfg, rng
+    )
+    assert len(out_boxes) == 0 and len(out_labels) == 0
+
+
+def test_photometric_stays_uint8_in_range():
+    rng = np.random.default_rng(3)
+    cfg = TransformConfig(
+        rotation=(0, 0), translation=(0, 0), shear=(0, 0), scaling=(1, 1),
+        flip_x_prob=0.0, brightness=(0.5, 0.5), contrast=(2.0, 2.0),
+        saturation=(1.5, 1.5),
+    )
+    image = rng.integers(0, 255, (16, 16, 3), dtype=np.uint8)
+    out, _, _ = apply_random_transform(
+        image, np.zeros((0, 4), np.float32), np.zeros((0,), np.int32), cfg, rng
+    )
+    assert out.dtype == np.uint8
+    assert out.max() <= 255 and out.min() >= 0
+    assert out.mean() > image.mean()  # +0.5 brightness dominates
+
+
+def test_transform_is_deterministic_given_rng():
+    cfg = TransformConfig()
+    img = np.random.default_rng(5).integers(0, 255, (24, 24, 3), dtype=np.uint8)
+    boxes = np.array([[4.0, 4.0, 16.0, 16.0]], np.float32)
+    labels = np.array([0], np.int32)
+    a = apply_random_transform(img, boxes, labels, cfg, np.random.default_rng(7))
+    b = apply_random_transform(img, boxes, labels, cfg, np.random.default_rng(7))
+    np.testing.assert_array_equal(a[0], b[0])
+    np.testing.assert_allclose(a[1], b[1])
+
+
+def test_pipeline_with_transform_config(tmp_path):
+    """End-to-end: augmented pipeline yields valid batches inside the bucket."""
+    from batchai_retinanet_horovod_coco_tpu.data import (
+        CocoDataset,
+        PipelineConfig,
+        build_pipeline,
+        make_synthetic_coco,
+    )
+
+    ann = make_synthetic_coco(str(tmp_path), num_images=6, num_classes=2, seed=4)
+    ds = CocoDataset(ann, image_dir=f"{tmp_path}/train")
+    cfg = PipelineConfig(
+        batch_size=2,
+        buckets=((320, 320),),
+        min_side=300,
+        max_side=320,
+        max_gt=8,
+        transform=TransformConfig(),
+        num_workers=2,
+        seed=0,
+    )
+    batch = next(build_pipeline(ds, cfg, train=True))
+    assert batch.images.shape == (2, 320, 320, 3)
+    if batch.gt_mask.any():
+        valid = batch.gt_boxes[batch.gt_mask]
+        assert np.all(valid >= -1e-3) and np.all(valid <= 320 + 1e-3)
+        assert np.all(valid[:, 2] > valid[:, 0])

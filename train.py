@@ -115,6 +115,10 @@ def build_parser() -> argparse.ArgumentParser:
         g.add_argument("--image-max-side", type=int, default=1333)
         g.add_argument("--max-gt", type=int, default=100)
         g.add_argument("--workers", type=int, default=8)
+        g.add_argument("--random-transform", action="store_true",
+                       help="full random affine + photometric augmentation "
+                            "(reference --random-transform; default is "
+                            "hflip-only)")
 
         g = sp.add_argument_group("optimization")
         g.add_argument("--steps", type=int, default=90000)
@@ -305,6 +309,11 @@ def main(argv=None) -> dict[str, float]:
         seed=args.seed,
         num_workers=args.workers,
     )
+    train_transform = None
+    if getattr(args, "random_transform", False):
+        from batchai_retinanet_horovod_coco_tpu.data import TransformConfig
+
+        train_transform = TransformConfig()
     detect_config = DetectConfig(
         score_threshold=args.score_threshold,
         iou_threshold=args.nms_threshold,
@@ -348,7 +357,7 @@ def main(argv=None) -> dict[str, float]:
     train_batches = build_pipeline(
         train_ds,
         PipelineConfig(
-            batch_size=local_batch, shuffle=True,
+            batch_size=local_batch, shuffle=True, transform=train_transform,
             shard_index=shard_index, shard_count=shard_count, **pipe_common,
         ),
         train=True,

@@ -13,9 +13,12 @@ Design notes (TPU-first):
 - In addition to the per-anchor rule we force-assign, for every valid gt, the
   anchor with the highest IoU (the RetinaNet paper's low-quality-match rescue;
   without it small objects can end up with zero positive anchors).
-- Outputs are dense fixed-shape tensors consumed directly by the losses:
-  one-hot class targets, box-delta targets, and a per-anchor state in
-  {-1 ignore, 0 negative, 1 positive}.
+- Outputs are dense fixed-shape tensors consumed directly by the losses.
+  The train step uses the compact form (:func:`anchor_targets_compact`):
+  integer matched labels, box-delta targets, and a per-anchor state in
+  {-1 ignore, 0 negative, 1 positive}; the focal loss reconstructs the
+  one-hot implicitly.  :func:`anchor_targets` materializes the one-hot
+  (A, K) form for tests/tools (the keras-retinanet surface).
 """
 
 from __future__ import annotations
@@ -23,6 +26,7 @@ from __future__ import annotations
 import dataclasses
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 
 from batchai_retinanet_horovod_coco_tpu.ops.boxes import BoxCodecConfig, encode_boxes
@@ -48,6 +52,21 @@ class AnchorAssignment(NamedTuple):
 
 class AnchorTargets(NamedTuple):
     cls_targets: jnp.ndarray  # (A, num_classes) one-hot float
+    box_targets: jnp.ndarray  # (A, 4) encoded deltas (valid where positive)
+    state: jnp.ndarray  # (A,) int32
+
+
+class CompactTargets(NamedTuple):
+    """Targets without the dense (A, K) one-hot — the train-step form.
+
+    The one-hot classification target is recoverable as
+    ``(matched_labels[:, None] == arange(K)) & (state == POSITIVE)``; keeping
+    it implicit lets the focal loss fuse that comparison into its elementwise
+    computation instead of writing a (B, A, K) float tensor to HBM (~0.5 GB
+    per step at the flagship bucket — measured 49 ms → see losses.py).
+    """
+
+    matched_labels: jnp.ndarray  # (A,) int32 class id of the matched gt
     box_targets: jnp.ndarray  # (A, 4) encoded deltas (valid where positive)
     state: jnp.ndarray  # (A,) int32
 
@@ -105,6 +124,48 @@ def assign_anchors(
     return AnchorAssignment(matched_gt=matched_gt, state=state)
 
 
+def anchor_targets_compact(
+    anchors: jnp.ndarray,
+    gt_boxes: jnp.ndarray,
+    gt_labels: jnp.ndarray,
+    gt_mask: jnp.ndarray,
+    matching: MatchingConfig = MatchingConfig(),
+    codec: BoxCodecConfig = BoxCodecConfig(),
+) -> CompactTargets:
+    """Per-anchor targets for one image, classification kept as int labels.
+
+    vmap over a leading batch axis for batched use (anchors held constant):
+    ``jax.vmap(anchor_targets_compact, in_axes=(None, 0, 0, 0))``.
+    """
+    assignment = assign_anchors(anchors, gt_boxes, gt_mask, matching)
+    # Matched gt rows via one-hot matmul rather than a gather: a TPU gather of
+    # ~200k rows from a tiny table serializes (profiled at ~20 ms/step at the
+    # flagship bucket, the single hottest op) while the (A, G) @ (G, 5) dot is
+    # MXU work measured at ~2 ms.  HIGHEST precision keeps it bit-exact in
+    # f32 (each one-hot row selects exactly one value; default TPU matmul
+    # precision would round coords through bf16).
+    num_gt = gt_boxes.shape[0]
+    onehot = (
+        assignment.matched_gt[:, None] == jnp.arange(num_gt, dtype=jnp.int32)
+    ).astype(jnp.float32)  # (A, G)
+    packed = jnp.concatenate(
+        [gt_boxes.astype(jnp.float32), gt_labels.astype(jnp.float32)[:, None]],
+        axis=1,
+    )  # (G, 5): x1 y1 x2 y2 label
+    matched = jnp.dot(onehot, packed, precision=jax.lax.Precision.HIGHEST)
+    matched_boxes = matched[:, :4]  # (A, 4)
+    matched_labels = matched[:, 4].astype(jnp.int32)  # (A,)
+
+    positive = assignment.state == POSITIVE
+    box_targets = encode_boxes(anchors, matched_boxes, codec)
+    box_targets = jnp.where(positive[:, None], box_targets, 0.0)
+    return CompactTargets(
+        matched_labels=matched_labels,
+        box_targets=box_targets,
+        state=assignment.state,
+    )
+
+
 def anchor_targets(
     anchors: jnp.ndarray,
     gt_boxes: jnp.ndarray,
@@ -116,24 +177,27 @@ def anchor_targets(
 ) -> AnchorTargets:
     """Dense per-anchor classification + regression targets for one image.
 
-    vmap over a leading batch axis for batched use (anchors held constant):
-    ``jax.vmap(anchor_targets, in_axes=(None, 0, 0, 0, None))``.
+    The keras-retinanet ``anchor_targets_bbox`` surface (one-hot cls targets).
+    The train step uses :func:`anchor_targets_compact` instead — materializing
+    (A, K) here is fine for tests/tools but wasteful inside the hot step.
+    The one-hot is built with a broadcast compare, not a scatter: TPU scatter
+    serializes; an (A, K) equality against an iota vectorizes on the VPU.
     """
-    assignment = assign_anchors(anchors, gt_boxes, gt_mask, matching)
-    matched_boxes = gt_boxes[assignment.matched_gt]  # (A, 4)
-    matched_labels = gt_labels[assignment.matched_gt]  # (A,)
-
-    positive = assignment.state == POSITIVE
-    cls_targets = (
-        jnp.zeros((anchors.shape[0], num_classes), dtype=jnp.float32)
-        .at[jnp.arange(anchors.shape[0]), jnp.clip(matched_labels, 0, num_classes - 1)]
-        .set(1.0)
+    compact = anchor_targets_compact(
+        anchors, gt_boxes, gt_labels, gt_mask, matching, codec
     )
-    cls_targets = jnp.where(positive[:, None], cls_targets, 0.0)
-    box_targets = encode_boxes(anchors, matched_boxes, codec)
-    box_targets = jnp.where(positive[:, None], box_targets, 0.0)
+    positive = compact.state == POSITIVE
+    cls_targets = jnp.where(
+        positive[:, None]
+        & (
+            compact.matched_labels[:, None]
+            == jnp.arange(num_classes, dtype=jnp.int32)[None, :]
+        ),
+        1.0,
+        0.0,
+    ).astype(jnp.float32)
     return AnchorTargets(
         cls_targets=cls_targets,
-        box_targets=box_targets,
-        state=assignment.state,
+        box_targets=compact.box_targets,
+        state=compact.state,
     )

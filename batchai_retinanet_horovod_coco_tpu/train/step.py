@@ -42,7 +42,6 @@ def _forward_and_loss(
     gt_labels: jnp.ndarray,
     gt_mask: jnp.ndarray,
     anchors: jnp.ndarray,
-    num_classes: int,
     loss_config: losses_lib.LossConfig,
     matching_config: matching_lib.MatchingConfig,
     train: bool,
@@ -60,15 +59,17 @@ def _forward_and_loss(
         new_batch_stats = state.batch_stats
 
     # On-device target assignment; no gradients flow into the matching.
+    # Compact form: integer labels instead of a dense (A, K) one-hot — the
+    # focal loss fuses the implicit one-hot (losses.focal_loss_compact).
     targets = jax.vmap(
-        matching_lib.anchor_targets, in_axes=(None, 0, 0, 0, None, None)
-    )(anchors, gt_boxes, gt_labels, gt_mask, num_classes, matching_config)
+        matching_lib.anchor_targets_compact, in_axes=(None, 0, 0, 0, None)
+    )(anchors, gt_boxes, gt_labels, gt_mask, matching_config)
     targets = jax.tree.map(lax.stop_gradient, targets)
 
-    metrics = losses_lib.total_loss(
+    metrics = losses_lib.total_loss_compact(
         outputs["cls_logits"],
         outputs["box_deltas"],
-        targets.cls_targets,
+        targets.matched_labels,
         targets.box_targets,
         targets.state,
         loss_config,
@@ -111,7 +112,7 @@ def make_train_step(
             lambda p: _forward_and_loss(
                 model, state, p,
                 batch["images"], batch["gt_boxes"], batch["gt_labels"],
-                batch["gt_mask"], anchors, num_classes, loss_config,
+                batch["gt_mask"], anchors, loss_config,
                 matching_config, train=True,
             ),
             has_aux=True,

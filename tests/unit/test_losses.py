@@ -120,3 +120,36 @@ def test_per_image_normalization():
         per_image.append(li)
     want = np.mean(per_image)
     np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_focal_compact_matches_dense():
+    """focal_loss_compact(int labels) == focal_loss(one-hot) exactly."""
+    from batchai_retinanet_horovod_coco_tpu.losses import (
+        focal_loss_compact,
+        total_loss,
+        total_loss_compact,
+    )
+
+    rng = np.random.default_rng(7)
+    B, A, K = 3, 16, 5
+    logits = rng.normal(0, 2, (B, A, K)).astype(np.float32)
+    box_preds = rng.normal(0, 1, (B, A, 4)).astype(np.float32)
+    box_t = rng.normal(0, 1, (B, A, 4)).astype(np.float32)
+    labels = rng.integers(0, K, (B, A)).astype(np.int32)
+    state = rng.choice([-1, 0, 1], (B, A)).astype(np.int32)
+
+    one_hot = np.zeros((B, A, K), dtype=np.float32)
+    for b in range(B):
+        for a in range(A):
+            if state[b, a] == 1:
+                one_hot[b, a, labels[b, a]] = 1.0
+
+    np.testing.assert_allclose(
+        float(focal_loss_compact(logits, labels, state)),
+        float(focal_loss(logits, one_hot, state)),
+        rtol=1e-6,
+    )
+    dense = total_loss(logits, box_preds, one_hot, box_t, state)
+    compact = total_loss_compact(logits, box_preds, labels, box_t, state)
+    for k in dense:
+        np.testing.assert_allclose(float(compact[k]), float(dense[k]), rtol=1e-6)

@@ -96,3 +96,34 @@ def test_force_match_survives_gt_padding():
     assert np.asarray(out.matched_gt)[0] == 0
     tgt = anchor_targets(anchors, gt, labels, mask, num_classes=5)
     assert np.asarray(tgt.cls_targets)[0, 2] == 1.0
+
+
+def test_anchor_targets_compact_matches_dense():
+    """Compact targets reconstruct exactly the dense one-hot targets."""
+    from batchai_retinanet_horovod_coco_tpu.ops.matching import (
+        anchor_targets,
+        anchor_targets_compact,
+    )
+
+    rng = np.random.default_rng(3)
+    A_n, G, K = 64, 7, 4
+    anchors = np.sort(rng.uniform(0, 100, (A_n, 2, 2)), axis=1).reshape(A_n, 4)[
+        :, [0, 2, 1, 3]
+    ].astype(np.float32)
+    gt = np.sort(rng.uniform(0, 100, (G, 2, 2)), axis=1).reshape(G, 4)[
+        :, [0, 2, 1, 3]
+    ].astype(np.float32)
+    labels = rng.integers(0, K, G).astype(np.int32)
+    mask = np.array([True] * 5 + [False] * 2)
+
+    dense = anchor_targets(anchors, gt, labels, mask, K)
+    compact = anchor_targets_compact(anchors, gt, labels, mask)
+
+    np.testing.assert_array_equal(np.asarray(dense.state), np.asarray(compact.state))
+    np.testing.assert_allclose(
+        np.asarray(dense.box_targets), np.asarray(compact.box_targets)
+    )
+    pos = np.asarray(compact.state) == 1
+    rebuilt = np.zeros((A_n, K), dtype=np.float32)
+    rebuilt[np.arange(A_n)[pos], np.asarray(compact.matched_labels)[pos]] = 1.0
+    np.testing.assert_array_equal(np.asarray(dense.cls_targets), rebuilt)

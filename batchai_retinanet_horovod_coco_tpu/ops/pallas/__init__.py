@@ -1,0 +1,13 @@
+"""Pallas TPU kernels for hot ops (SURVEY.md §2.5).
+
+Each kernel ships with a pure-jnp fallback and is validated against it in
+interpret mode on CPU (tests/unit/test_pallas_focal.py).  Kernels are
+opt-in: they are only used where they measure faster than XLA's lowering
+on real hardware (see each module's MEASURED note).
+"""
+
+from batchai_retinanet_horovod_coco_tpu.ops.pallas.focal import (
+    focal_loss_per_image_sums,
+)
+
+__all__ = ["focal_loss_per_image_sums"]

@@ -26,6 +26,7 @@ from batchai_retinanet_horovod_coco_tpu.parallel.mesh import (
     batch_sharding,
     replicated_sharding,
 )
+from batchai_retinanet_horovod_coco_tpu.train import optim
 from batchai_retinanet_horovod_coco_tpu.train.state import TrainState
 from batchai_retinanet_horovod_coco_tpu.train.step import make_train_step
 from batchai_retinanet_horovod_coco_tpu.utils.checkpoint import CheckpointManager
@@ -158,6 +159,9 @@ def run_training(
             scalars["images_per_sec"] = window_images / max(dt, 1e-9)
             if schedule is not None:
                 scalars["lr"] = float(schedule(step - 1))
+                scale = optim.plateau_scale(state.opt_state)
+                if scale is not None:
+                    scalars["lr"] *= scale  # data-driven ReduceLROnPlateau
             logger.log(step, scalars)
             window_t0 = time.perf_counter()
             window_images = 0

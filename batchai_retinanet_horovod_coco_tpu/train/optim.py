@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 
+import jax
 import optax
 
 
@@ -26,9 +27,20 @@ class OptimizerConfig:
     world_size: int = 1
     warmup_steps: int = 500
     total_steps: int = 90_000
-    schedule: str = "multistep"  # "multistep" | "cosine" | "constant"
+    schedule: str = "multistep"  # "multistep" | "cosine" | "constant" | "plateau"
     # Multistep: decay 10x at these fractions of total_steps (detectron 1x).
     milestones: tuple[float, ...] = (2 / 3, 8 / 9)
+    # "plateau": the reference's ReduceLROnPlateau (keras-retinanet monitors
+    # per-epoch training loss, factor 0.1, patience 2).  TPU-native redesign:
+    # no callback — optax.contrib.reduce_on_plateau rides INSIDE the compiled
+    # step, fed the pmean-ed loss, so every replica scales identically and
+    # the controller state checkpoints/restores with the rest of opt_state.
+    # ``plateau_window`` steps of loss are averaged per comparison (the epoch
+    # analogue); patience counts windows.
+    plateau_factor: float = 0.1
+    plateau_patience: int = 2
+    plateau_window: int = 1000
+    plateau_min_delta: float = 1e-4
     momentum: float = 0.9
     weight_decay: float = 1e-4
     clip_global_norm: float = 10.0
@@ -46,7 +58,9 @@ def make_schedule(config: OptimizerConfig) -> optax.Schedule:
     # join_schedules rebases the post-warmup schedule to step 0 at the join,
     # so boundaries/horizons are expressed relative to the end of warmup —
     # milestones land at the intended GLOBAL step.
-    if config.schedule == "constant":
+    if config.schedule in ("constant", "plateau"):
+        # plateau: base LR is flat; the reduce_on_plateau transform in
+        # make_optimizer supplies the data-driven decay.
         sched = optax.constant_schedule(peak)
     elif config.schedule == "cosine":
         sched = optax.cosine_decay_schedule(
@@ -96,4 +110,38 @@ def make_optimizer(
         tx = optax.multi_transform(
             {"trained": tx, "frozen": optax.set_to_zero()}, label
         )
-    return tx, schedule
+
+    if config.schedule == "plateau":
+        # Appended last so the scale multiplies the whole update (= scaling
+        # the LR).  The step feeds it value=loss via apply_gradients.
+        tx = optax.chain(
+            tx,
+            optax.contrib.reduce_on_plateau(
+                factor=config.plateau_factor,
+                patience=config.plateau_patience,
+                # rtol=0: improvement is judged against the ABSOLUTE
+                # min_delta (keras ReduceLROnPlateau semantics), not optax's
+                # default best_value-relative threshold.
+                rtol=0.0,
+                atol=config.plateau_min_delta,
+                accumulation_size=config.plateau_window,
+            ),
+        )
+    return optax.with_extra_args_support(tx), schedule
+
+
+def plateau_scale(opt_state) -> float | None:
+    """Current ReduceLROnPlateau LR scale in ``opt_state`` (None if absent).
+
+    Matches the controller's state node by type — a name-based search
+    ("scale") collides with fields of other optax states in the chain.
+    """
+    plateau_state = optax.contrib.ReduceLROnPlateauState
+    found = [
+        x
+        for x in jax.tree.leaves(
+            opt_state, is_leaf=lambda x: isinstance(x, plateau_state)
+        )
+        if isinstance(x, plateau_state)
+    ]
+    return float(found[0].scale) if found else None

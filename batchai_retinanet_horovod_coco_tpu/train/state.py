@@ -44,8 +44,30 @@ class TrainState:
     # Static (non-pytree) fields:
     tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
 
-    def apply_gradients(self, grads: Any, new_batch_stats: Any | None = None):
-        updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+    def apply_gradients(
+        self,
+        grads: Any,
+        new_batch_stats: Any | None = None,
+        *,
+        loss_value: jnp.ndarray | None = None,
+    ):
+        """One optimizer update.
+
+        ``loss_value`` (the replica-identical pmean-ed loss) is forwarded to
+        extra-args transforms — optax.contrib.reduce_on_plateau consumes it
+        as ``value`` (train/optim.py "plateau" schedule); plain transforms
+        never see it.
+        """
+        if loss_value is not None and isinstance(
+            self.tx, optax.GradientTransformationExtraArgs
+        ):
+            updates, new_opt_state = self.tx.update(
+                grads, self.opt_state, self.params, value=loss_value
+            )
+        else:
+            updates, new_opt_state = self.tx.update(
+                grads, self.opt_state, self.params
+            )
         new_params = optax.apply_updates(self.params, updates)
         return self.replace(
             step=self.step + 1,

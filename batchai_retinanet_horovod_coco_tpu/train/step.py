@@ -124,7 +124,9 @@ def make_train_step(
         @partial(jax.jit, donate_argnums=(0,) if donate_state else ())
         def train_step(state: TrainState, batch: dict[str, Any]):
             grads, metrics, new_bs = local_step(state, batch)
-            new_state = state.apply_gradients(grads, new_bs)
+            new_state = state.apply_gradients(
+                grads, new_bs, loss_value=metrics["loss"]
+            )
             return new_state, metrics
 
         return train_step
@@ -147,7 +149,9 @@ def make_train_step(
         metrics["num_pos"] = num_pos
         if state.batch_stats:
             new_bs = lax.pmean(new_bs, DATA_AXIS)  # sync-BN semantics
-        new_state = state.apply_gradients(grads, new_bs)
+        new_state = state.apply_gradients(
+            grads, new_bs, loss_value=metrics["loss"]
+        )
         return new_state, metrics
 
     return jax.jit(sharded_step, donate_argnums=(0,) if donate_state else ())

@@ -30,6 +30,16 @@ class TestParseArgs:
         assert args.batch_size == 4
         assert args.num_devices == 8
 
+    def test_plateau_schedule_flags(self):
+        args = parse_args(
+            ["synthetic", "--schedule", "plateau", "--plateau-factor", "0.5",
+             "--plateau-patience", "3", "--plateau-window", "50"]
+        )
+        assert args.schedule == "plateau"
+        assert args.plateau_factor == 0.5
+        assert args.plateau_patience == 3
+        assert args.plateau_window == 50
+
     def test_coco_paths(self):
         args = parse_args(["coco", "/data/coco"])
         assert args.coco_path == "/data/coco"

@@ -10,6 +10,7 @@ from batchai_retinanet_horovod_coco_tpu.train.optim import (
     make_optimizer,
     make_schedule,
     peak_lr,
+    plateau_scale,
 )
 
 
@@ -64,3 +65,91 @@ class TestFreezeBackbone:
         updates, _ = tx.update(grads, opt_state, params)
         np.testing.assert_array_equal(updates["backbone"]["w"], 0.0)
         assert float(jnp.abs(updates["fpn"]["w"]).sum()) > 0
+
+
+class TestPlateau:
+    """ReduceLROnPlateau parity (reference monitors loss, factor/patience)."""
+
+    def _cfg(self, **kw):
+        return OptimizerConfig(
+            schedule="plateau", warmup_steps=0, global_batch_size=256,
+            weight_decay=0.0, plateau_factor=0.1, plateau_patience=1,
+            plateau_window=2, plateau_min_delta=1e-8, **kw,
+        )
+
+    def _run(self, losses):
+        tx, _ = make_optimizer(self._cfg())
+        params = {"w": jnp.ones((3,))}
+        opt_state = tx.init(params)
+        grads = {"w": jnp.ones((3,))}
+        scales = []
+        for v in losses:
+            _, opt_state = tx.update(
+                grads, opt_state, params, value=jnp.asarray(v, jnp.float32)
+            )
+            scales.append(plateau_scale(opt_state))
+        return scales
+
+    def test_flat_loss_reduces_scale(self):
+        # window=2, patience=1: every flat window after the best is a
+        # plateau, so the scale steps down by `factor` per window.
+        scales = self._run([1.0] * 8)
+        assert scales[0] == pytest.approx(1.0)
+        reduced = [s for s in scales if s < 1.0]
+        assert reduced and reduced[0] == pytest.approx(0.1)
+        assert scales == sorted(scales, reverse=True)  # monotone decay
+
+    def test_improving_loss_keeps_scale(self):
+        scales = self._run([1.0, 0.9, 0.8, 0.7, 0.6, 0.5, 0.4, 0.3])
+        assert scales[-1] == pytest.approx(1.0)
+
+    def test_absolute_min_delta_semantics(self):
+        # Keras parity regression: improvement is judged absolutely, not
+        # relative to best_value.  At loss ~100 improving 0.005/window,
+        # optax's default rtol=1e-4 (threshold 100*1e-4=0.01) would declare
+        # a plateau and cut the LR; the absolute semantics must not.
+        tx, _ = make_optimizer(self._cfg())
+        params = {"w": jnp.ones((3,))}
+        opt_state = tx.init(params)
+        grads = {"w": jnp.ones((3,))}
+        v = 100.0
+        for _ in range(10):
+            _, opt_state = tx.update(
+                grads, opt_state, params, value=jnp.asarray(v, jnp.float32)
+            )
+            v -= 0.0025  # 0.005 improvement per window of 2
+        assert plateau_scale(opt_state) == pytest.approx(1.0)
+
+    def test_scale_none_without_plateau(self):
+        tx, _ = make_optimizer(
+            OptimizerConfig(schedule="constant", warmup_steps=0,
+                            global_batch_size=256)
+        )
+        params = {"w": jnp.ones((3,))}
+        assert plateau_scale(tx.init(params)) is None
+
+    def test_apply_gradients_threads_loss_value(self):
+        # The TrainState path: plateau state advances inside apply_gradients.
+        from batchai_retinanet_horovod_coco_tpu.train.state import TrainState
+
+        tx, _ = make_optimizer(self._cfg())
+        params = {"w": jnp.ones((3,))}
+        state = TrainState(
+            step=jnp.zeros((), jnp.int32), params=params, batch_stats={},
+            opt_state=tx.init(params), tx=tx,
+        )
+        for _ in range(8):
+            state = state.apply_gradients(
+                {"w": jnp.ones((3,))}, loss_value=jnp.asarray(1.0)
+            )
+        assert plateau_scale(state.opt_state) < 1.0
+        # Plain (non-extra-args) transforms still work without loss_value.
+        import optax
+
+        plain = TrainState(
+            step=jnp.zeros((), jnp.int32), params=params, batch_stats={},
+            opt_state=optax.sgd(0.1).init(params), tx=optax.sgd(0.1),
+        )
+        plain = plain.apply_gradients({"w": jnp.ones((3,))},
+                                      loss_value=jnp.asarray(1.0))
+        assert int(plain.step) == 1

@@ -125,7 +125,16 @@ def build_parser() -> argparse.ArgumentParser:
         g.add_argument("--lr", type=float, default=0.01)
         g.add_argument("--optimizer", default="sgd", choices=["sgd", "adam"])
         g.add_argument("--schedule", default="multistep",
-                       choices=["multistep", "cosine", "constant"])
+                       choices=["multistep", "cosine", "constant", "plateau"])
+        g.add_argument("--plateau-factor", type=float, default=0.1,
+                       help="LR multiplier on plateau (--schedule plateau)")
+        g.add_argument("--plateau-patience", type=int, default=2,
+                       help="non-improving windows before reducing")
+        g.add_argument("--plateau-window", type=int, default=1000,
+                       help="steps of loss averaged per window (epoch analogue)")
+        g.add_argument("--plateau-min-delta", type=float, default=1e-4,
+                       help="absolute loss improvement below which a window "
+                            "counts as a plateau")
         g.add_argument("--warmup-steps", type=int, default=500)
         g.add_argument("--weight-decay", type=float, default=1e-4)
         g.add_argument("--seed", type=int, default=0)
@@ -149,6 +158,11 @@ def build_parser() -> argparse.ArgumentParser:
         g = sp.add_argument_group("distributed")
         g.add_argument("--num-devices", type=int, default=1,
                        help="devices in the data mesh; 0 = all global devices")
+        g.add_argument("--platform", default="auto",
+                       choices=["auto", "cpu", "tpu"],
+                       help="cpu: run the full SPMD path on a virtual CPU "
+                            "mesh of --num-devices (CI / laptops, "
+                            "SURVEY.md §7.3); auto: default backend")
         g.add_argument("--distributed-auto", action="store_true",
                        help="jax.distributed.initialize() from TPU metadata")
         g.add_argument("--coordinator-address", default=None)
@@ -212,6 +226,19 @@ def make_datasets(args):
 def main(argv=None) -> dict[str, float]:
     args = parse_args(argv)
 
+    if args.platform != "auto":
+        # Must land before any backend initialization.  The CPU path also
+        # forces enough virtual host devices for the requested mesh
+        # (xla_force_host_platform_device_count is read at backend init).
+        if args.platform == "cpu" and args.num_devices > 1:
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    f"{flags} --xla_force_host_platform_device_count="
+                    f"{args.num_devices}"
+                ).strip()
+        jax.config.update("jax_platforms", args.platform)
+
     from batchai_retinanet_horovod_coco_tpu.data import PipelineConfig, build_pipeline
     from batchai_retinanet_horovod_coco_tpu.evaluate.detect import (
         DetectConfig,
@@ -269,6 +296,10 @@ def main(argv=None) -> dict[str, float]:
         warmup_steps=args.warmup_steps,
         total_steps=args.steps,
         schedule=args.schedule,
+        plateau_factor=args.plateau_factor,
+        plateau_patience=args.plateau_patience,
+        plateau_window=args.plateau_window,
+        plateau_min_delta=args.plateau_min_delta,
         weight_decay=args.weight_decay,
         freeze_backbone=args.freeze_backbone,
     )

@@ -121,9 +121,11 @@ def make_optimizer(
                 patience=config.plateau_patience,
                 # rtol=0: improvement is judged against the ABSOLUTE
                 # min_delta (keras ReduceLROnPlateau semantics), not optax's
-                # default best_value-relative threshold.
+                # default best_value-relative threshold.  optax rejects
+                # rtol == atol == 0, so min_delta=0 (legal in keras) is
+                # floored at a value far below any f32 loss resolution.
                 rtol=0.0,
-                atol=config.plateau_min_delta,
+                atol=max(config.plateau_min_delta, 1e-12),
                 accumulation_size=config.plateau_window,
             ),
         )

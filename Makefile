@@ -1,0 +1,45 @@
+# Cluster lifecycle targets — the operator surface of the reference's W3/W4
+# layer (SURVEY.md §2.1: Makefile + Batch AI cluster/job JSON), retargeted at
+# Cloud TPU pod slices.  Every target delegates to launch/cluster.py, which
+# is unit-tested and supports DRY=1 to print the gcloud command instead of
+# running it.
+#
+#   make create NAME=ret-pod ACCEL=v5litepod-256
+#   make submit NAME=ret-pod TRAIN_ARGS="--preset pod coco /mnt/coco"
+#   make status NAME=ret-pod
+#   make delete NAME=ret-pod
+#   make test | make bench | make smoke
+
+NAME ?= retinanet-pod
+ZONE ?= us-east5-b
+ACCEL ?= v5litepod-256
+TRAIN_ARGS ?= --preset pod coco /mnt/coco
+DRY ?=
+DRYFLAG = $(if $(DRY),--dry-run,)
+CLUSTER = python -m batchai_retinanet_horovod_coco_tpu.launch.cluster
+
+.PHONY: create submit status delete test smoke bench
+
+create:
+	$(CLUSTER) create --name $(NAME) --zone $(ZONE) --accelerator $(ACCEL) $(DRYFLAG)
+
+submit:
+	$(CLUSTER) submit --name $(NAME) --zone $(ZONE) $(DRYFLAG) -- $(TRAIN_ARGS)
+
+status:
+	$(CLUSTER) status --name $(NAME) --zone $(ZONE) $(DRYFLAG)
+
+delete:
+	$(CLUSTER) delete --name $(NAME) --zone $(ZONE) $(DRYFLAG)
+
+test:
+	python -m pytest tests/ -q
+
+# End-to-end synthetic smoke on a virtual CPU mesh (no data, no TPU needed).
+smoke:
+	python train.py synthetic --platform cpu --backbone resnet_test --f32 \
+	  --image-min-side 64 --image-max-side 64 --batch-size 8 --num-devices 8 \
+	  --steps 20 --synthetic-size 64
+
+bench:
+	python bench.py

@@ -12,4 +12,31 @@ from batchai_retinanet_horovod_coco_tpu.launch.pod import (
     shard_info,
 )
 
-__all__ = ["DistributedConfig", "initialize_distributed", "shard_info"]
+_CLUSTER_EXPORTS = (
+    "TPUClusterConfig",
+    "create_command",
+    "delete_command",
+    "status_command",
+    "submit_command",
+)
+
+
+def __getattr__(name: str):
+    # Lazy (PEP 562): `python -m ...launch.cluster` would otherwise warn
+    # about the module pre-existing in sys.modules (runpy double import).
+    if name in _CLUSTER_EXPORTS:
+        from batchai_retinanet_horovod_coco_tpu.launch import cluster
+
+        return getattr(cluster, name)
+    raise AttributeError(name)
+
+__all__ = [
+    "DistributedConfig",
+    "TPUClusterConfig",
+    "create_command",
+    "delete_command",
+    "initialize_distributed",
+    "shard_info",
+    "status_command",
+    "submit_command",
+]

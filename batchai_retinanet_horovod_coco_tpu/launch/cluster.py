@@ -1,0 +1,175 @@
+"""Cloud TPU pod-slice provisioning + job submission (the Batch AI layer).
+
+Reference W3/W4 (SURVEY.md §2.1): a Makefile + `az` CLI calls create a Batch
+AI cluster of N GPU VMs with blob storage mounted, and a job JSON submits
+`mpirun python train.py` over those nodes.  TPU-native equivalent: `gcloud`
+provisions a TPU pod slice (one LOGICAL resource — no per-VM fleet to
+assemble), and job submission is `ssh --worker=all` running the SAME
+`train.py --distributed-auto` on every host; `jax.distributed.initialize()`
+does rank discovery from TPU metadata, so there is no mpirun, no hostfile,
+and no container registry in the loop.
+
+This module GENERATES the commands (dataclass config -> argv lists) and can
+execute them when gcloud is present.  Generation is pure and unit-tested
+(tests/unit/test_cluster.py); `--dry-run` prints exactly what would run —
+the air-gapped analogue of checking the reference's cluster/job JSON into
+the repo.
+
+Usage:
+    python -m batchai_retinanet_horovod_coco_tpu.launch.cluster \
+        create --name ret-pod --accelerator v5litepod-256 --dry-run
+    python -m ....launch.cluster submit --name ret-pod \
+        -- --preset pod coco /mnt/coco --dry-run
+    python -m ....launch.cluster status|delete --name ret-pod --dry-run
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import shlex
+import subprocess
+import sys
+
+
+@dataclasses.dataclass(frozen=True)
+class TPUClusterConfig:
+    """A TPU pod slice (the W3 'cluster' — one gcloud resource).
+
+    ``accelerator``: e.g. v5litepod-8 .. v5litepod-256 (BASELINE.json's
+    8->256-chip scaling range).  ``queued``: use queued-resources (the
+    capacity-friendly path) instead of direct tpu-vm create.
+    """
+
+    name: str = "retinanet-pod"
+    zone: str = "us-east5-b"
+    project: str | None = None
+    accelerator: str = "v5litepod-256"
+    runtime_version: str = "v2-alpha-tpuv5-lite"
+    spot: bool = False
+    queued: bool = False
+    network: str | None = None
+
+
+def _base(cfg: TPUClusterConfig, *parts: str) -> list[str]:
+    cmd = ["gcloud", *parts, f"--zone={cfg.zone}"]
+    if cfg.project:
+        cmd.append(f"--project={cfg.project}")
+    return cmd
+
+
+def create_command(cfg: TPUClusterConfig) -> list[str]:
+    """Provision the slice (reference: `az batchai cluster create` + JSON)."""
+    if cfg.queued:
+        cmd = _base(
+            cfg, "compute", "tpus", "queued-resources", "create", cfg.name
+        )
+        cmd += [
+            f"--node-id={cfg.name}-0",
+            f"--accelerator-type={cfg.accelerator}",
+            f"--runtime-version={cfg.runtime_version}",
+        ]
+    else:
+        cmd = _base(cfg, "compute", "tpus", "tpu-vm", "create", cfg.name)
+        cmd += [
+            f"--accelerator-type={cfg.accelerator}",
+            f"--version={cfg.runtime_version}",
+        ]
+    if cfg.spot:
+        cmd.append("--spot")
+    if cfg.network:
+        cmd.append(f"--network={cfg.network}")
+    return cmd
+
+
+def delete_command(cfg: TPUClusterConfig) -> list[str]:
+    kind = "queued-resources" if cfg.queued else "tpu-vm"
+    return _base(cfg, "compute", "tpus", kind, "delete", cfg.name, "--quiet")
+
+
+def status_command(cfg: TPUClusterConfig) -> list[str]:
+    kind = "queued-resources" if cfg.queued else "tpu-vm"
+    return _base(cfg, "compute", "tpus", kind, "describe", cfg.name)
+
+
+def submit_command(
+    cfg: TPUClusterConfig,
+    train_args: list[str],
+    workdir: str = "batchai_retinanet_horovod_coco_tpu",
+) -> list[str]:
+    """The W4 'job': run train.py on EVERY host of the slice simultaneously.
+
+    The reference needed an MPI job spec (processCount, hostfile, container
+    image); here every host runs the identical command and the TPU metadata
+    server supplies topology to ``jax.distributed.initialize()``
+    (launch/pod.py) — `--distributed-auto` is the entire integration.
+
+    ``workdir`` is resolved on the remote host (ssh lands in $HOME, so a
+    relative path means "under the home dir").
+    """
+    train = " ".join(
+        shlex.quote(a)
+        for a in ["python", "train.py", *train_args, "--distributed-auto",
+                  "--num-devices", "0"]
+    )
+    # Queued provisioning creates the node as '{name}-0' (create_command's
+    # --node-id); direct tpu-vm create uses the name itself.
+    node = f"{cfg.name}-0" if cfg.queued else cfg.name
+    cmd = _base(cfg, "compute", "tpus", "tpu-vm", "ssh", node)
+    cmd += [
+        "--worker=all",
+        f"--command=cd {shlex.quote(workdir)} && {train}",
+    ]
+    return cmd
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="cluster", description=__doc__.split("\n", 1)[0]
+    )
+    p.add_argument("action", choices=["create", "submit", "status", "delete"])
+    p.add_argument("--name", default="retinanet-pod")
+    p.add_argument("--zone", default="us-east5-b")
+    p.add_argument("--project", default=None)
+    p.add_argument("--accelerator", default="v5litepod-256")
+    p.add_argument("--runtime-version", default="v2-alpha-tpuv5-lite")
+    p.add_argument("--spot", action="store_true")
+    p.add_argument("--queued", action="store_true",
+                   help="provision via queued-resources")
+    p.add_argument("--workdir", default="batchai_retinanet_horovod_coco_tpu",
+                   help="remote dir (relative = under $HOME on each host)")
+    p.add_argument("--dry-run", action="store_true",
+                   help="print the gcloud command instead of running it")
+    # Everything after `--` is the train.py command line (submit only);
+    # flags BEFORE it are parsed strictly so typos error instead of being
+    # silently dropped.
+    argv = sys.argv[1:] if argv is None else list(argv)
+    train_args: list[str] = []
+    if "--" in argv:
+        split = argv.index("--")
+        argv, train_args = argv[:split], argv[split + 1:]
+    args = p.parse_args(argv)
+    if train_args and args.action != "submit":
+        p.error("train.py args after '--' are only valid with 'submit'")
+
+    cfg = TPUClusterConfig(
+        name=args.name, zone=args.zone, project=args.project,
+        accelerator=args.accelerator, runtime_version=args.runtime_version,
+        spot=args.spot, queued=args.queued,
+    )
+    cmd = {
+        "create": lambda: create_command(cfg),
+        "delete": lambda: delete_command(cfg),
+        "status": lambda: status_command(cfg),
+        "submit": lambda: submit_command(cfg, train_args, args.workdir),
+    }[args.action]()
+
+    if args.dry_run:
+        print(" ".join(shlex.quote(c) for c in cmd))
+        return 0
+    return subprocess.call(cmd)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

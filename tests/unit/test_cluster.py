@@ -1,0 +1,125 @@
+"""Launch-layer command generation (SURVEY.md W3/W4 parity).
+
+The reference's cluster/job specs were JSON checked into the repo; here the
+equivalent artifact is the generated gcloud argv, asserted exactly.
+"""
+
+import shlex
+import subprocess
+import sys
+
+from batchai_retinanet_horovod_coco_tpu.launch import (
+    TPUClusterConfig,
+    create_command,
+    delete_command,
+    status_command,
+    submit_command,
+)
+from batchai_retinanet_horovod_coco_tpu.launch.cluster import main
+
+
+class TestCommands:
+    def test_create_tpu_vm(self):
+        cfg = TPUClusterConfig(
+            name="ret", zone="us-east5-b", accelerator="v5litepod-8",
+            runtime_version="rt",
+        )
+        assert create_command(cfg) == [
+            "gcloud", "compute", "tpus", "tpu-vm", "create", "ret",
+            "--zone=us-east5-b", "--accelerator-type=v5litepod-8",
+            "--version=rt",
+        ]
+
+    def test_create_queued_spot_project(self):
+        cfg = TPUClusterConfig(
+            name="ret", project="proj", accelerator="v5litepod-256",
+            runtime_version="rt", spot=True, queued=True,
+        )
+        cmd = create_command(cfg)
+        assert cmd[:6] == [
+            "gcloud", "compute", "tpus", "queued-resources", "create", "ret",
+        ]
+        assert "--project=proj" in cmd
+        assert "--node-id=ret-0" in cmd
+        assert "--accelerator-type=v5litepod-256" in cmd
+        assert "--spot" in cmd
+
+    def test_delete_and_status(self):
+        import dataclasses
+
+        cfg = TPUClusterConfig(name="ret")
+        assert delete_command(cfg)[4:] == ["delete", "ret", "--quiet",
+                                           f"--zone={cfg.zone}"]
+        assert status_command(cfg)[4] == "describe"
+        queued = dataclasses.replace(cfg, queued=True)
+        assert delete_command(queued)[3] == "queued-resources"
+
+    def test_submit_runs_same_binary_on_all_workers(self):
+        cfg = TPUClusterConfig(name="ret")
+        cmd = submit_command(cfg, ["--preset", "pod", "coco", "/mnt/coco"])
+        assert cmd[:6] == ["gcloud", "compute", "tpus", "tpu-vm", "ssh", "ret"]
+        assert "--worker=all" in cmd
+        command = cmd[-1]
+        assert command.startswith("--command=")
+        # The whole W4 job spec: same train.py + --distributed-auto + all
+        # devices; no mpirun, no hostfile, no processCount.
+        assert "python train.py --preset pod coco /mnt/coco " \
+               "--distributed-auto --num-devices 0" in command
+        assert "mpirun" not in command
+
+    def test_submit_quotes_args(self):
+        cfg = TPUClusterConfig(name="ret")
+        cmd = submit_command(cfg, ["coco", "/path with space"])
+        assert shlex.quote("/path with space") in cmd[-1]
+
+    def test_submit_quotes_workdir(self):
+        cfg = TPUClusterConfig(name="ret")
+        cmd = submit_command(cfg, ["coco", "/d"], workdir="shared data/repo")
+        assert "cd 'shared data/repo' &&" in cmd[-1]
+
+    def test_submit_targets_queued_node(self):
+        import dataclasses
+
+        cfg = dataclasses.replace(TPUClusterConfig(name="ret"), queued=True)
+        # Queued create names the node 'ret-0'; submit must ssh THAT node.
+        assert submit_command(cfg, ["coco", "/d"])[5] == "ret-0"
+
+
+class TestCLI:
+    def test_dry_run_prints_command(self, capsys):
+        rc = main(["create", "--name", "x", "--accelerator", "v5litepod-8",
+                   "--dry-run"])
+        assert rc == 0
+        out = capsys.readouterr().out.strip()
+        assert out.startswith("gcloud compute tpus tpu-vm create x")
+
+    def test_submit_passthrough_after_dashdash(self, capsys):
+        rc = main(["submit", "--name", "x", "--dry-run", "--",
+                   "--preset", "pod", "coco", "/mnt/coco"])
+        assert rc == 0
+        assert "--preset pod coco /mnt/coco" in capsys.readouterr().out
+
+    def test_typo_flag_errors_instead_of_silently_dropping(self):
+        import pytest
+
+        with pytest.raises(SystemExit) as e:
+            main(["create", "--name", "x", "--acclerator", "v5litepod-8",
+                  "--dry-run"])
+        assert e.value.code == 2  # argparse usage error
+
+    def test_train_args_rejected_for_non_submit(self):
+        import pytest
+
+        with pytest.raises(SystemExit) as e:
+            main(["create", "--name", "x", "--dry-run", "--", "coco", "/d"])
+        assert e.value.code == 2
+
+    def test_module_entrypoint(self):
+        out = subprocess.run(
+            [sys.executable, "-m",
+             "batchai_retinanet_horovod_coco_tpu.launch.cluster",
+             "status", "--name", "y", "--dry-run"],
+            capture_output=True, timeout=120,
+        )
+        assert out.returncode == 0
+        assert b"describe y" in out.stdout

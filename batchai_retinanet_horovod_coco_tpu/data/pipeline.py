@@ -35,6 +35,8 @@ from typing import NamedTuple
 
 import numpy as np
 
+from batchai_retinanet_horovod_coco_tpu.data.transforms import cv2  # shared fallback
+
 from batchai_retinanet_horovod_coco_tpu.data.coco import CocoDataset, ImageRecord
 from batchai_retinanet_horovod_coco_tpu.data.transforms import (
     TransformConfig,
@@ -43,6 +45,9 @@ from batchai_retinanet_horovod_coco_tpu.data.transforms import (
 
 IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], dtype=np.float32)
 IMAGENET_STD = np.array([0.229, 0.224, 0.225], dtype=np.float32)
+# Normalize as two fused in-place passes: x*scale - offset == (x/255-m)/s.
+_NORM_SCALE = (1.0 / (255.0 * IMAGENET_STD)).astype(np.float32)
+_NORM_OFFSET = (IMAGENET_MEAN / IMAGENET_STD).astype(np.float32)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -153,11 +158,17 @@ def load_example(
     nh = min(bh, int(round(h * scale)))
     nw = min(bw, int(round(w * scale)))
     if (nh, nw) != (h, w):
-        image = np.asarray(
-            Image.fromarray(image).resize((nw, nh), Image.BILINEAR), dtype=np.uint8
-        )
+        if cv2 is not None:  # ~3x PIL for bilinear resize; releases the GIL
+            image = cv2.resize(image, (nw, nh), interpolation=cv2.INTER_LINEAR)
+        else:
+            image = np.asarray(
+                Image.fromarray(image).resize((nw, nh), Image.BILINEAR),
+                dtype=np.uint8,
+            )
         boxes = boxes * scale
-    normalized = (image.astype(np.float32) / 255.0 - IMAGENET_MEAN) / IMAGENET_STD
+    normalized = image.astype(np.float32)
+    normalized *= _NORM_SCALE
+    normalized -= _NORM_OFFSET
     return normalized, boxes, labels, scale
 
 
@@ -252,6 +263,26 @@ def build_pipeline(
             pool.shutdown(wait=False)
 
     def _produce(pool: ThreadPoolExecutor) -> None:
+            from collections import deque
+
+            # Keep several batches' decode futures in flight so the pool
+            # never drains at a batch boundary (the naive submit-one-batch/
+            # wait/assemble loop caps parallelism at batch_size and measured
+            # ~11 imgs/s regardless of worker count).  Batches are EMITTED
+            # in submission order — determinism is unchanged.
+            max_inflight = max(
+                2, -(-config.num_workers // max(1, config.batch_size)) + 1
+            )
+            inflight: deque = deque()
+
+            def flush_one() -> bool:
+                futures, ids, bucket, short = inflight.popleft()
+                examples = [f.result() for f in futures]
+                batch = _assemble(examples, ids, bucket, config)
+                if short:
+                    batch = _pad_batch(batch, config.batch_size)
+                return _put(batch)
+
             epoch = 0
             while not stop.is_set():
                 indices = epoch_indices(epoch)
@@ -278,14 +309,15 @@ def build_pipeline(
                             )
                             for i in chunk
                         ]
-                        examples = [f.result() for f in futures]
                         ids = [dataset.records[i].image_id for i in chunk]
-                        batch = _assemble(examples, ids, bucket, config)
-                        if not train and len(chunk) < config.batch_size:
-                            batch = _pad_batch(batch, config.batch_size)
-                        if not _put(batch):
+                        short = not train and len(chunk) < config.batch_size
+                        inflight.append((futures, ids, bucket, short))
+                        if len(inflight) >= max_inflight and not flush_one():
                             return
                 if not train:
+                    while inflight:
+                        if not flush_one():
+                            return
                     _put(_SENTINEL)
                     return
                 epoch += 1

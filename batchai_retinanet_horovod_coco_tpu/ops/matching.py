@@ -43,6 +43,13 @@ class MatchingConfig:
     negative_iou: float = 0.4
     # Force-match each gt's best anchor even below positive_iou.
     force_match_best: bool = True
+    # Batched assignment via the fused Pallas kernel (ops/pallas/matching.py)
+    # instead of the vmapped XLA lowering: None = auto (TPU backend only),
+    # True/False = force.  See the kernel module docstring for the measured
+    # HBM-traffic win.
+    fused_pallas: bool | None = None
+    # Interpreter-mode pallas (CPU tests of the fused path).
+    pallas_interpret: bool = False
 
 
 class AnchorAssignment(NamedTuple):
@@ -71,6 +78,51 @@ class CompactTargets(NamedTuple):
     state: jnp.ndarray  # (A,) int32
 
 
+def _finalize_states(
+    max_iou: jnp.ndarray,
+    gt_best_iou: jnp.ndarray,
+    gt_best_anchor: jnp.ndarray,
+    gt_mask: jnp.ndarray,
+    num_anchors: int,
+    config: MatchingConfig,
+) -> tuple[jnp.ndarray, jnp.ndarray | None]:
+    """The matching RULE, shared by the XLA and fused-Pallas paths.
+
+    Thresholds + force-match rescue from the per-anchor/per-gt IoU
+    reductions (one image).  Returns ``(state, forced_target)`` where
+    ``forced_target`` (G,) routes each force-matched gt to its best anchor
+    (out-of-range index ``num_anchors`` = not forced, dropped by scatters);
+    None when force-matching is disabled.
+
+    Keeping this in ONE place is what guarantees the two assignment
+    backends can never drift apart on the rule itself (the kernels only
+    compute reductions; tests/unit/test_pallas_matching.py pins equality).
+    """
+    any_gt = jnp.any(gt_mask)
+    positive = (max_iou >= config.positive_iou) & any_gt
+    negative = max_iou < config.negative_iou
+
+    forced_target = None
+    if config.force_match_best:
+        # For each valid gt with some overlap (> 0), its argmax anchor
+        # becomes positive for that gt.  Non-forced gts (padding / no
+        # overlap) are routed to out-of-range index A so mode="drop"
+        # discards them — they must not clobber real writes at anchor 0
+        # (argmax of an all-zero IoU column is 0).
+        force = gt_mask & (gt_best_iou > 0.0)
+        forced_target = jnp.where(force, gt_best_anchor, num_anchors)
+        forced_flag = jnp.zeros(num_anchors, dtype=bool).at[forced_target].set(
+            True, mode="drop"
+        )
+        positive = positive | forced_flag
+        negative = negative & ~forced_flag
+
+    state = jnp.full(num_anchors, IGNORE, dtype=jnp.int32)
+    state = jnp.where(negative, NEGATIVE, state)
+    state = jnp.where(positive, POSITIVE, state)
+    return state, forced_target
+
+
 def assign_anchors(
     anchors: jnp.ndarray,
     gt_boxes: jnp.ndarray,
@@ -84,43 +136,31 @@ def assign_anchors(
       gt_boxes: (G, 4) corner boxes, padded rows arbitrary.
       gt_mask: (G,) bool, True for real gt rows.
     """
+    num_anchors = anchors.shape[0]
     iou = pairwise_iou(anchors, gt_boxes)  # (A, G)
     iou = jnp.where(gt_mask[None, :], iou, 0.0)
 
     matched_gt = jnp.argmax(iou, axis=1).astype(jnp.int32)  # (A,)
     max_iou = jnp.max(iou, axis=1)  # (A,)
 
-    any_gt = jnp.any(gt_mask)
-    positive = (max_iou >= config.positive_iou) & any_gt
-    negative = max_iou < config.negative_iou
-
-    if config.force_match_best:
-        # For each valid gt, its argmax anchor becomes positive for that gt.
-        best_anchor = jnp.argmax(iou, axis=0)  # (G,)
-        # Guard: only gts with some overlap (> 0) get a forced anchor.
-        has_overlap = jnp.max(iou, axis=0) > 0.0
-        force = gt_mask & has_overlap
-        # Scatter gt g onto anchor best_anchor[g].  Non-forced gts (padding /
-        # no overlap) are routed to out-of-range index A so mode="drop"
-        # discards them — they must not clobber real writes at anchor 0
-        # (argmax of an all-zero IoU column is 0).
-        num_anchors = anchors.shape[0]
-        target = jnp.where(force, best_anchor, num_anchors)
-        forced_flag = jnp.zeros(num_anchors, dtype=bool).at[target].set(
+    state, forced_target = _finalize_states(
+        max_iou,
+        jnp.max(iou, axis=0),
+        jnp.argmax(iou, axis=0).astype(jnp.int32),
+        gt_mask,
+        num_anchors,
+        config,
+    )
+    if forced_target is not None:
+        forced_flag = jnp.zeros(num_anchors, dtype=bool).at[forced_target].set(
             True, mode="drop"
         )
         forced_idx = (
             jnp.zeros(num_anchors, dtype=jnp.int32)
-            .at[target]
+            .at[forced_target]
             .set(jnp.arange(gt_boxes.shape[0], dtype=jnp.int32), mode="drop")
         )
         matched_gt = jnp.where(forced_flag, forced_idx, matched_gt)
-        positive = positive | forced_flag
-        negative = negative & ~forced_flag
-
-    state = jnp.full(anchors.shape[0], IGNORE, dtype=jnp.int32)
-    state = jnp.where(negative, NEGATIVE, state)
-    state = jnp.where(positive, POSITIVE, state)
     return AnchorAssignment(matched_gt=matched_gt, state=state)
 
 
@@ -163,6 +203,71 @@ def anchor_targets_compact(
         matched_labels=matched_labels,
         box_targets=box_targets,
         state=assignment.state,
+    )
+
+
+def anchor_targets_compact_batched(
+    anchors: jnp.ndarray,
+    gt_boxes: jnp.ndarray,
+    gt_labels: jnp.ndarray,
+    gt_mask: jnp.ndarray,
+    matching: MatchingConfig = MatchingConfig(),
+    codec: BoxCodecConfig = BoxCodecConfig(),
+) -> CompactTargets:
+    """Batched :func:`anchor_targets_compact` — the train-step entrypoint.
+
+    Dispatches between the vmapped XLA path and the fused Pallas kernel
+    (``MatchingConfig.fused_pallas``); both produce identical targets
+    (tests/unit/test_pallas_matching.py).  Inputs carry a leading batch dim
+    except ``anchors`` (shared).
+    """
+    fused = matching.fused_pallas
+    if fused is None:
+        fused = jax.default_backend() == "tpu"
+    if not fused:
+        return jax.vmap(
+            anchor_targets_compact, in_axes=(None, 0, 0, 0, None, None)
+        )(anchors, gt_boxes, gt_labels, gt_mask, matching, codec)
+
+    from batchai_retinanet_horovod_coco_tpu.ops.pallas.matching import (
+        assign_fused,
+    )
+
+    matched_boxes, matched_labels, max_iou, gt_best_iou, gt_best_anchor = (
+        assign_fused(
+            anchors, gt_boxes, gt_labels, gt_mask,
+            interpret=matching.pallas_interpret,
+        )
+    )
+    num_anchors = anchors.shape[0]
+
+    def finish_one(miou, best_iou, best_anchor, boxes, labels, mask, mb, ml):
+        state, forced_target = _finalize_states(
+            miou, best_iou, best_anchor, mask, num_anchors, matching
+        )
+        if forced_target is not None:
+            # The kernel's matched rows reflect the pre-force argmax; patch
+            # the ≤G force-matched anchors with their gt's box/label.
+            mb = mb.at[forced_target].set(
+                boxes.astype(jnp.float32), mode="drop"
+            )
+            ml = ml.at[forced_target].set(
+                labels.astype(jnp.int32), mode="drop"
+            )
+        return state, mb, ml
+
+    state, matched_boxes, matched_labels = jax.vmap(finish_one)(
+        max_iou, gt_best_iou, gt_best_anchor, gt_boxes, gt_labels, gt_mask,
+        matched_boxes, matched_labels,
+    )
+
+    positive = state == POSITIVE
+    box_targets = encode_boxes(anchors[None], matched_boxes, codec)
+    box_targets = jnp.where(positive[..., None], box_targets, 0.0)
+    return CompactTargets(
+        matched_labels=matched_labels,
+        box_targets=box_targets,
+        state=state,
     )
 
 

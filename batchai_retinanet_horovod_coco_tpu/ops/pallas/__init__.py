@@ -9,5 +9,8 @@ on real hardware (see each module's MEASURED note).
 from batchai_retinanet_horovod_coco_tpu.ops.pallas.focal import (
     focal_loss_per_image_sums,
 )
+from batchai_retinanet_horovod_coco_tpu.ops.pallas.matching import (
+    assign_fused,
+)
 
-__all__ = ["focal_loss_per_image_sums"]
+__all__ = ["assign_fused", "focal_loss_per_image_sums"]

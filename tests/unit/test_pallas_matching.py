@@ -1,0 +1,114 @@
+"""Fused Pallas assignment vs the vmapped jnp path (interpret mode).
+
+The kernel must reproduce ``anchor_targets_compact`` exactly: IoU values,
+first-tie argmax, force-match rescue, thresholds, and encoded box targets.
+"""
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from batchai_retinanet_horovod_coco_tpu.ops import anchors as A
+from batchai_retinanet_horovod_coco_tpu.ops import matching as M
+
+FUSED = M.MatchingConfig(fused_pallas=True, pallas_interpret=True)
+JNP = M.MatchingConfig(fused_pallas=False)
+
+
+def _rand_scene(B=2, G=7, hw=(64, 64), seed=0, empty_images=()):
+    rng = np.random.default_rng(seed)
+    h, w = hw
+    boxes = np.zeros((B, G, 4), np.float32)
+    labels = rng.integers(0, 3, (B, G)).astype(np.int32)
+    mask = np.zeros((B, G), bool)
+    for b in range(B):
+        n = 0 if b in empty_images else int(rng.integers(1, G + 1))
+        xy = rng.uniform(0, [w - 8, h - 8], (n, 2))
+        wh = rng.uniform(4, 40, (n, 2))
+        boxes[b, :n, 0] = xy[:, 0]
+        boxes[b, :n, 1] = xy[:, 1]
+        boxes[b, :n, 2] = np.minimum(xy[:, 0] + wh[:, 0], w)
+        boxes[b, :n, 3] = np.minimum(xy[:, 1] + wh[:, 1], h)
+        mask[b, :n] = True
+    return jnp.asarray(boxes), jnp.asarray(labels), jnp.asarray(mask)
+
+
+def _assert_targets_equal(got, want):
+    np.testing.assert_array_equal(np.asarray(got.state), np.asarray(want.state))
+    # Labels only matter where positive (elsewhere the one-hot is masked).
+    pos = np.asarray(want.state) == M.POSITIVE
+    np.testing.assert_array_equal(
+        np.asarray(got.matched_labels)[pos], np.asarray(want.matched_labels)[pos]
+    )
+    np.testing.assert_allclose(
+        np.asarray(got.box_targets), np.asarray(want.box_targets),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_matches_jnp_path(seed):
+    anchors = jnp.asarray(A.anchors_for_image_shape((64, 64)))
+    boxes, labels, mask = _rand_scene(seed=seed)
+    got = M.anchor_targets_compact_batched(anchors, boxes, labels, mask, FUSED)
+    want = M.anchor_targets_compact_batched(anchors, boxes, labels, mask, JNP)
+    _assert_targets_equal(got, want)
+
+
+def test_empty_scene_all_negative():
+    anchors = jnp.asarray(A.anchors_for_image_shape((64, 64)))
+    boxes, labels, mask = _rand_scene(B=2, seed=3, empty_images=(0, 1))
+    got = M.anchor_targets_compact_batched(anchors, boxes, labels, mask, FUSED)
+    want = M.anchor_targets_compact_batched(anchors, boxes, labels, mask, JNP)
+    _assert_targets_equal(got, want)
+    assert not np.any(np.asarray(got.state) == M.POSITIVE)
+
+
+def test_mixed_empty_and_populated():
+    anchors = jnp.asarray(A.anchors_for_image_shape((64, 64)))
+    boxes, labels, mask = _rand_scene(B=3, seed=4, empty_images=(1,))
+    got = M.anchor_targets_compact_batched(anchors, boxes, labels, mask, FUSED)
+    want = M.anchor_targets_compact_batched(anchors, boxes, labels, mask, JNP)
+    _assert_targets_equal(got, want)
+
+
+def test_force_match_small_boxes():
+    """Tiny gts below the positive threshold still get their best anchor."""
+    anchors = jnp.asarray(A.anchors_for_image_shape((64, 64)))
+    boxes = jnp.asarray(
+        [[[10.0, 10.0, 13.0, 13.0], [40.0, 40.0, 44.0, 43.0]]], jnp.float32
+    )
+    labels = jnp.asarray([[1, 2]], jnp.int32)
+    mask = jnp.ones((1, 2), bool)
+    got = M.anchor_targets_compact_batched(anchors, boxes, labels, mask, FUSED)
+    want = M.anchor_targets_compact_batched(anchors, boxes, labels, mask, JNP)
+    _assert_targets_equal(got, want)
+    assert int(np.sum(np.asarray(got.state) == M.POSITIVE)) >= 2
+
+
+def test_no_force_match_variant():
+    anchors = jnp.asarray(A.anchors_for_image_shape((64, 64)))
+    boxes, labels, mask = _rand_scene(seed=5)
+    fused = dataclasses.replace(FUSED, force_match_best=False)
+    plain = dataclasses.replace(JNP, force_match_best=False)
+    got = M.anchor_targets_compact_batched(anchors, boxes, labels, mask, fused)
+    want = M.anchor_targets_compact_batched(anchors, boxes, labels, mask, plain)
+    _assert_targets_equal(got, want)
+
+
+def test_anchor_tail_not_divisible_by_tile():
+    """A < TILE_A and A % 8 == 0 tail: in-range masking must hold."""
+    anchors = jnp.asarray(A.anchors_for_image_shape((32, 32)))
+    assert anchors.shape[0] % pl_tile() != 0
+    boxes, labels, mask = _rand_scene(hw=(32, 32), seed=6)
+    got = M.anchor_targets_compact_batched(anchors, boxes, labels, mask, FUSED)
+    want = M.anchor_targets_compact_batched(anchors, boxes, labels, mask, JNP)
+    _assert_targets_equal(got, want)
+
+
+def pl_tile():
+    from batchai_retinanet_horovod_coco_tpu.ops.pallas.matching import TILE_A
+
+    return TILE_A

@@ -59,8 +59,21 @@ class RetinaNet(nn.Module):
     config: RetinaNetConfig
 
     @nn.compact
-    def __call__(self, images: jnp.ndarray, train: bool = False) -> dict[str, jnp.ndarray]:
-        """(B, H, W, 3) float images → {"cls_logits": (B, A, K), "box_deltas": (B, A, 4)}."""
+    def __call__(
+        self,
+        images: jnp.ndarray,
+        train: bool = False,
+        return_levels: bool = False,
+    ) -> dict[str, Any]:
+        """(B, H, W, 3) float images → {"cls_logits": (B, A, K), "box_deltas": (B, A, 4)}.
+
+        ``return_levels=True`` returns the PER-LEVEL outputs instead
+        ({"cls_levels": tuple of (B, A_l, K), "box_levels": ...}, P3→P7 in
+        anchor order) and skips the concatenation, for consumers like
+        ``losses.total_loss_compact_levels`` (measured slightly SLOWER than
+        the concatenated form in the flagship train step — see that
+        function's docstring — so the step does not use it).
+        """
         cfg = self.config
         stages = _BACKBONE_STAGES.get(cfg.backbone)
         if stages is None:
@@ -102,6 +115,12 @@ class RetinaNet(nn.Module):
                 cls_out.append(cls_head(feat))
                 box_out.append(box_head(feat))
 
+        if return_levels:
+            # Losses run in f32; cast per level (fuses into the head convs).
+            return {
+                "cls_levels": tuple(o.astype(jnp.float32) for o in cls_out),
+                "box_levels": tuple(o.astype(jnp.float32) for o in box_out),
+            }
         return {
             # Losses run in f32; cast once here so downstream ops are f32.
             "cls_logits": jnp.concatenate(cls_out, axis=1).astype(jnp.float32),

@@ -153,3 +153,51 @@ def test_focal_compact_matches_dense():
     compact = total_loss_compact(logits, box_preds, labels, box_t, state)
     for k in dense:
         np.testing.assert_allclose(float(compact[k]), float(dense[k]), rtol=1e-6)
+
+
+def test_levels_matches_concat():
+    """Per-level losses == concatenated losses (up to f32 sum order)."""
+    from batchai_retinanet_horovod_coco_tpu.losses import (
+        total_loss_compact,
+        total_loss_compact_levels,
+    )
+
+    rng = np.random.default_rng(9)
+    B, K = 2, 5
+    level_sizes = (300, 80, 20)
+    A = sum(level_sizes)
+    logits = rng.normal(0, 2, (B, A, K)).astype(np.float32)
+    box_preds = rng.normal(0, 1, (B, A, 4)).astype(np.float32)
+    box_t = rng.normal(0, 1, (B, A, 4)).astype(np.float32)
+    labels = rng.integers(0, K, (B, A)).astype(np.int32)
+    state = rng.choice([-1, 0, 1], (B, A), p=[0.2, 0.7, 0.1]).astype(np.int32)
+
+    cls_levels, box_levels, off = [], [], 0
+    for n in level_sizes:
+        cls_levels.append(logits[:, off : off + n])
+        box_levels.append(box_preds[:, off : off + n])
+        off += n
+
+    want = total_loss_compact(logits, box_preds, labels, box_t, state)
+    got = total_loss_compact_levels(
+        tuple(cls_levels), tuple(box_levels), labels, box_t, state
+    )
+    for k in want:
+        np.testing.assert_allclose(float(got[k]), float(want[k]), rtol=1e-5)
+
+
+def test_levels_size_mismatch_raises():
+    from batchai_retinanet_horovod_coco_tpu.losses import (
+        total_loss_compact_levels,
+    )
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="cover"):
+        total_loss_compact_levels(
+            (np.zeros((1, 10, 3)),),
+            (np.zeros((1, 10, 4)),),
+            np.zeros((1, 12), np.int32),
+            np.zeros((1, 12, 4)),
+            np.zeros((1, 12), np.int32),
+        )

@@ -101,3 +101,26 @@ def test_bf16_compute_f32_params():
     assert all(leaf.dtype == jnp.float32 for leaf in leaves)
     out = model.apply(variables, jnp.zeros((1, *HW, 3)))
     assert out["cls_logits"].dtype == jnp.float32  # cast back at the boundary
+
+
+def test_return_levels_concat_equals_default(tiny_model_and_state):
+    """Levels mode is the same computation, pre-concatenation, P3->P7."""
+    import numpy as np
+
+    model, state = tiny_model_and_state
+    from batchai_retinanet_horovod_coco_tpu.train.state import model_variables
+
+    images = jnp.asarray(
+        np.random.default_rng(0).normal(0, 1, (2, 64, 64, 3)).astype(np.float32)
+    )
+    variables = model_variables(state)
+    flat = model.apply(variables, images, train=False)
+    levels = model.apply(variables, images, train=False, return_levels=True)
+    np.testing.assert_allclose(
+        np.asarray(jnp.concatenate(levels["cls_levels"], axis=1)),
+        np.asarray(flat["cls_logits"]), rtol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(jnp.concatenate(levels["box_levels"], axis=1)),
+        np.asarray(flat["box_deltas"]), rtol=1e-6,
+    )

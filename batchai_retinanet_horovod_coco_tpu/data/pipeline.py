@@ -48,6 +48,26 @@ IMAGENET_STD = np.array([0.229, 0.224, 0.225], dtype=np.float32)
 # Normalize as two fused in-place passes: x*scale - offset == (x/255-m)/s.
 _NORM_SCALE = (1.0 / (255.0 * IMAGENET_STD)).astype(np.float32)
 _NORM_OFFSET = (IMAGENET_MEAN / IMAGENET_STD).astype(np.float32)
+# uint8 batch padding ≈ the dataset mean, i.e. ~0.0 in normalized space —
+# matching the reference's pad-with-zeros-AFTER-preprocessing semantics.
+_PAD_PIXEL = np.round(IMAGENET_MEAN * 255.0).astype(np.uint8)
+
+
+def normalize_images(images):
+    """Device-side ImageNet normalization for uint8 image batches.
+
+    TPU-first redesign of the reference's host-side ``preprocess_image``
+    (SURVEY.md M8): the pipeline ships uint8 (4x less host work, host RAM
+    and PCIe traffic); this cast+scale runs on device, where XLA fuses it
+    into the stem conv's input. f32 inputs pass through unchanged
+    (pre-normalized arrays from tests/tools keep working).
+    """
+    import jax.numpy as jnp
+
+    if images.dtype != jnp.uint8:
+        return images
+    x = images.astype(jnp.float32)
+    return x * jnp.asarray(_NORM_SCALE) - jnp.asarray(_NORM_OFFSET)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -72,10 +92,14 @@ class PipelineConfig:
     num_workers: int = 8
     prefetch: int = 2
     drop_remainder: bool = True
+    # Default: ship uint8 and normalize ON DEVICE (see normalize_images).
+    # True restores the reference's host-side f32 preprocessing.
+    host_normalize: bool = False
 
 
 class Batch(NamedTuple):
-    images: np.ndarray  # (B, H, W, 3) float32, normalized
+    images: np.ndarray  # (B, H, W, 3) uint8 raw (device normalizes; see
+    # normalize_images) or float32 pre-normalized when host_normalize=True
     gt_boxes: np.ndarray  # (B, max_gt, 4) float32, resized coords
     gt_labels: np.ndarray  # (B, max_gt) int32
     gt_mask: np.ndarray  # (B, max_gt) bool
@@ -130,7 +154,8 @@ def load_example(
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray, float]:
     """Decode + (train-only) flip + resize one image into ``bucket``.
 
-    Returns (image f32 HWC normalized, boxes (N,4) resized, labels, scale).
+    Returns (image HWC — raw uint8 by default, f32 normalized when
+    ``config.host_normalize`` — boxes (N,4) resized, labels, scale).
     The image is NOT yet padded to the bucket, but is guaranteed to fit it:
     when no bucket fits the reference resize rule (extreme aspect ratios),
     the scale is capped so the image fits the one the producer chose.
@@ -166,10 +191,11 @@ def load_example(
                 dtype=np.uint8,
             )
         boxes = boxes * scale
-    normalized = image.astype(np.float32)
-    normalized *= _NORM_SCALE
-    normalized -= _NORM_OFFSET
-    return normalized, boxes, labels, scale
+    if config.host_normalize:
+        image = image.astype(np.float32)
+        image *= _NORM_SCALE
+        image -= _NORM_OFFSET
+    return image, boxes, labels, scale
 
 
 def _assemble(
@@ -180,7 +206,12 @@ def _assemble(
 ) -> Batch:
     b = len(examples)
     bh, bw = bucket
-    images = np.zeros((b, bh, bw, 3), dtype=np.float32)
+    if config.host_normalize:
+        images = np.zeros((b, bh, bw, 3), dtype=np.float32)
+    else:
+        # Pad with the dataset-mean pixel == ~0.0 in normalized space (the
+        # reference padded with zeros AFTER preprocessing).
+        images = np.broadcast_to(_PAD_PIXEL, (b, bh, bw, 3)).copy()
     gt_boxes = np.zeros((b, config.max_gt, 4), dtype=np.float32)
     gt_labels = np.zeros((b, config.max_gt), dtype=np.int32)
     gt_mask = np.zeros((b, config.max_gt), dtype=bool)

@@ -25,6 +25,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
+from batchai_retinanet_horovod_coco_tpu.data import pipeline as pipeline_lib
 from batchai_retinanet_horovod_coco_tpu.data.coco import CocoDataset
 from batchai_retinanet_horovod_coco_tpu.data.pipeline import Batch
 from batchai_retinanet_horovod_coco_tpu.evaluate.coco_eval import evaluate_detections
@@ -63,6 +64,8 @@ def make_detect_fn(
     )
 
     def detect(state, images: jnp.ndarray) -> nms_lib.Detections:
+        # uint8 batches normalize on device (data/pipeline.normalize_images).
+        images = pipeline_lib.normalize_images(images)
         outputs = model.apply(model_variables(state), images, train=False)
         scores = jax.nn.sigmoid(outputs["cls_logits"])  # (B, A, K)
         boxes = boxes_lib.decode_boxes(

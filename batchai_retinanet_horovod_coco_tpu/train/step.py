@@ -27,6 +27,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 from batchai_retinanet_horovod_coco_tpu import losses as losses_lib
+from batchai_retinanet_horovod_coco_tpu.data import pipeline as pipeline_lib
 from batchai_retinanet_horovod_coco_tpu.ops import anchors as anchors_lib
 from batchai_retinanet_horovod_coco_tpu.ops import matching as matching_lib
 from batchai_retinanet_horovod_coco_tpu.parallel.mesh import DATA_AXIS
@@ -48,6 +49,8 @@ def _forward_and_loss(
 ):
     variables = dict(model_variables(state), params=params)
     has_bn = "batch_stats" in variables
+    # uint8 batches normalize here, on device (data/pipeline.normalize_images).
+    images = pipeline_lib.normalize_images(images)
 
     if has_bn and train:
         outputs, mutated = model.apply(
@@ -172,6 +175,8 @@ def make_eval_forward(
     """
 
     def forward(state: TrainState, images: jnp.ndarray):
+        # uint8 batches normalize on device (data/pipeline.normalize_images).
+        images = pipeline_lib.normalize_images(images)
         return model.apply(model_variables(state), images, train=False)
 
     if mesh is None:

@@ -1,0 +1,68 @@
+"""Sharded eval == single-device eval (the detection analogue of the
+grad-equivalence test).
+
+The reference ran CocoEval on rank 0 only (SURVEY.md M10); here eval shards
+the batch over the `data` mesh axis and gathers detections — this pins the
+correctness of that path: identical Detections for the same global batch.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from batchai_retinanet_horovod_coco_tpu.evaluate.detect import (
+    DetectConfig,
+    make_detect_fn,
+)
+from batchai_retinanet_horovod_coco_tpu.parallel import make_mesh
+
+
+@pytest.fixture(scope="module")
+def model_state():
+    from batchai_retinanet_horovod_coco_tpu.models import (
+        RetinaNetConfig,
+        build_retinanet,
+    )
+    from batchai_retinanet_horovod_coco_tpu.train import create_train_state
+
+    model = build_retinanet(
+        RetinaNetConfig(
+            num_classes=3, backbone="resnet_test", fpn_channels=16,
+            head_width=16, head_depth=1, dtype=jnp.float32,
+        )
+    )
+    state = create_train_state(
+        model, optax.sgd(1e-2), (1, 64, 64, 3), jax.random.key(3)
+    )
+    return model, state
+
+
+def test_sharded_detect_equals_single_device(model_state):
+    model, state = model_state
+    hw = (64, 64)
+    rng = np.random.default_rng(0)
+    # uint8 batch: also exercises the on-device normalization under shard_map.
+    images = jnp.asarray(
+        rng.integers(0, 255, (8, *hw, 3), dtype=np.uint8)
+    )
+    cfg = DetectConfig(score_threshold=0.0, max_detections=20)
+
+    single = make_detect_fn(model, hw, cfg)(state, images)
+    sharded = make_detect_fn(model, hw, cfg, mesh=make_mesh(8))(state, images)
+
+    np.testing.assert_array_equal(
+        np.asarray(single.valid), np.asarray(sharded.valid)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(single.labels), np.asarray(sharded.labels)
+    )
+    np.testing.assert_allclose(
+        np.asarray(single.scores), np.asarray(sharded.scores), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(single.boxes), np.asarray(sharded.boxes),
+        rtol=1e-4, atol=1e-3,
+    )
+    assert bool(np.asarray(single.valid).any()), "degenerate test: no detections"

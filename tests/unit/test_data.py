@@ -78,8 +78,45 @@ def test_train_pipeline_shapes(synthetic_dataset):
     # Boxes are in resized coords, inside the bucket.
     valid_boxes = batch.gt_boxes[batch.gt_mask]
     assert np.all(valid_boxes[:, 2] <= 320 + 1e-3)
-    # Normalized images: roughly zero-centered.
-    assert abs(float(batch.images.mean())) < 2.0
+    # Default contract: raw uint8, normalized on device.
+    assert batch.images.dtype == np.uint8
+
+
+def test_host_normalize_and_device_normalize_agree(synthetic_dataset):
+    """uint8 + on-device normalize == host-side f32 normalize (same pixels)."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from batchai_retinanet_horovod_coco_tpu.data.pipeline import (
+        normalize_images,
+    )
+
+    cfg = PipelineConfig(
+        batch_size=2, buckets=((320, 320),), min_side=300, max_side=320,
+        shuffle=False, hflip_prob=0.0, seed=0,
+    )
+    raw = next(build_pipeline(synthetic_dataset, cfg, train=True))
+    host = next(
+        build_pipeline(
+            synthetic_dataset,
+            dataclasses.replace(cfg, host_normalize=True),
+            train=True,
+        )
+    )
+    assert raw.images.dtype == np.uint8
+    assert host.images.dtype == np.float32
+    on_device = np.asarray(normalize_images(jnp.asarray(raw.images)))
+    # Interior pixels identical (padding differs: mean-pixel uint8 vs 0.0).
+    np.testing.assert_allclose(
+        on_device[:, :300, :300], host.images[:, :300, :300],
+        rtol=1e-5, atol=1e-5,
+    )
+    # f32 passthrough: already-normalized arrays are untouched.
+    same = normalize_images(jnp.asarray(host.images))
+    np.testing.assert_array_equal(np.asarray(same), host.images)
+    # uint8 padding sits at ~0.0 in normalized space (reference semantics).
+    assert abs(float(on_device[:, 310:, 310:].mean())) < 0.02
 
 
 def test_eval_pipeline_covers_all_records_once(synthetic_dataset):

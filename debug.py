@@ -137,10 +137,10 @@ def _write_vis(out_dir: str, batch, anchors: np.ndarray, state: np.ndarray) -> N
 
     os.makedirs(out_dir, exist_ok=True)
     if batch.images.dtype == np.uint8:  # pipeline default: raw uint8
-        img = batch.images[0].astype(np.float32)
+        im = Image.fromarray(batch.images[0])
     else:  # host_normalize=True: invert the ImageNet normalization
         img = (batch.images[0] * IMAGENET_STD + IMAGENET_MEAN) * 255.0
-    im = Image.fromarray(np.clip(img, 0, 255).astype(np.uint8))
+        im = Image.fromarray(np.clip(img, 0, 255).astype(np.uint8))
     draw = ImageDraw.Draw(im)
     from batchai_retinanet_horovod_coco_tpu.ops.matching import POSITIVE
 

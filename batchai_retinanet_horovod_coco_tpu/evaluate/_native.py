@@ -45,7 +45,23 @@ def get_kernels() -> NativeKernels | None:
 
     from batchai_retinanet_horovod_coco_tpu.native import load_library
 
-    lib = load_library("cocoeval")
+    # BATCHAI_TPU_NATIVE_ASAN=1: AddressSanitizer build of the kernels
+    # (see tests/unit/test_native_asan.py).  Loading an ASAN .so without
+    # libasan ahead of it in the link order KILLS the interpreter (the ASAN
+    # runtime exits; no catchable exception), so honor the flag only when
+    # libasan is visibly preloaded — otherwise warn and keep the numpy
+    # fallback contract.
+    sanitize = bool(os.environ.get("BATCHAI_TPU_NATIVE_ASAN"))
+    if sanitize and "asan" not in os.environ.get("LD_PRELOAD", ""):
+        import warnings
+
+        warnings.warn(
+            "BATCHAI_TPU_NATIVE_ASAN set but libasan is not in LD_PRELOAD; "
+            "ignoring the flag (loading the ASAN .so would abort Python)",
+            RuntimeWarning,
+        )
+        sanitize = False
+    lib = load_library("cocoeval", sanitize=sanitize)
     if lib is None:
         _CACHED = (True, None)
         return None

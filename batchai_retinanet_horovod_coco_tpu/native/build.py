@@ -20,7 +20,7 @@ _LOCK = threading.Lock()
 _CACHE: dict[str, ctypes.CDLL | None] = {}
 
 
-def _compile(src: str, lib: str) -> bool:
+def _compile(src: str, lib: str, extra_flags: tuple[str, ...] = ()) -> bool:
     tmp_path = None
     try:
         with tempfile.NamedTemporaryFile(
@@ -33,7 +33,7 @@ def _compile(src: str, lib: str) -> bool:
         # parity with the numpy oracle (no FMA contraction).
         cmd = [
             "g++", "-O3", "-shared", "-fPIC", "-ffp-contract=off",
-            "-o", tmp_path, src,
+            *extra_flags, "-o", tmp_path, src,
         ]
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp_path, lib)  # atomic under concurrent builders
@@ -47,13 +47,25 @@ def _compile(src: str, lib: str) -> bool:
         return False
 
 
-def load_library(name: str = "cocoeval") -> ctypes.CDLL | None:
-    """Load (building if stale) ``native/<name>.cpp`` → CDLL, or None."""
+_ASAN_FLAGS = ("-fsanitize=address", "-g", "-fno-omit-frame-pointer")
+
+
+def load_library(name: str = "cocoeval", sanitize: bool = False) -> ctypes.CDLL | None:
+    """Load (building if stale) ``native/<name>.cpp`` → CDLL, or None.
+
+    ``sanitize=True`` builds an AddressSanitizer variant
+    (``lib<name>_asan.so``) — the §5.2 sanitizer target for the native
+    kernels (SURVEY.md).  Loading it requires libasan in the process
+    (LD_PRELOAD for a stock Python); tests/unit/test_native_asan.py runs
+    the kernels under it in a subprocess.
+    """
+    key = f"{name}+asan" if sanitize else name
     with _LOCK:
-        if name in _CACHE:
-            return _CACHE[name]
+        if key in _CACHE:
+            return _CACHE[key]
         src = os.path.join(_DIR, f"{name}.cpp")
-        lib = os.path.join(_DIR, f"lib{name}.so")
+        suffix = "_asan" if sanitize else ""
+        lib = os.path.join(_DIR, f"lib{name}{suffix}.so")
         result: ctypes.CDLL | None = None
         if os.path.exists(src):
             # Strict >: a fresh checkout gives .so and .cpp equal mtimes, and
@@ -61,10 +73,11 @@ def load_library(name: str = "cocoeval") -> ctypes.CDLL | None:
             fresh = os.path.exists(lib) and os.path.getmtime(
                 lib
             ) > os.path.getmtime(src)
-            if fresh or _compile(src, lib):
+            flags = _ASAN_FLAGS if sanitize else ()
+            if fresh or _compile(src, lib, flags):
                 try:
                     result = ctypes.CDLL(lib)
                 except OSError:
                     result = None
-        _CACHE[name] = result
+        _CACHE[key] = result
         return result

@@ -1,0 +1,82 @@
+"""AddressSanitizer run of the native COCOeval kernels (SURVEY.md §5.2).
+
+The reference stack had no sanitizer story; here the one hand-written C++
+component gets an ASAN gate: build the instrumented variant, exercise both
+kernels on adversarial fixtures in a subprocess with libasan preloaded, and
+fail on any sanitizer report.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+_SCRIPT = r"""
+import os
+import numpy as np
+
+from batchai_retinanet_horovod_coco_tpu.evaluate import _native
+
+kernels = _native.get_kernels()
+assert kernels is not None, "ASAN native build did not load"
+
+rng = np.random.default_rng(0)
+for trial in range(20):
+    n_gt = int(rng.integers(0, 7))
+    n_dt = int(rng.integers(0, 9))
+    gt = np.abs(rng.normal(10, 5, (n_gt, 4)))
+    dt = np.abs(rng.normal(10, 5, (n_dt, 4)))
+    crowd = rng.integers(0, 2, n_gt).astype(np.uint8)
+    iou = kernels.iou_matrix(dt, gt, crowd)
+    assert iou.shape == (n_dt, n_gt)
+    ignore = rng.integers(0, 2, n_gt).astype(np.uint8)
+    thrs = np.array([0.5, 0.75])
+    kernels.match_detections(iou, thrs, ignore, crowd)
+print("ASAN_DRIVE_OK")
+"""
+
+
+def _libasan() -> str | None:
+    try:
+        out = subprocess.run(
+            ["g++", "-print-file-name=libasan.so"],
+            capture_output=True, text=True, timeout=60,
+        )
+    except (OSError, subprocess.SubprocessError):
+        return None
+    path = out.stdout.strip()
+    return path if path and os.path.sep in path and os.path.exists(path) else None
+
+
+@pytest.mark.slow
+def test_native_kernels_under_asan():
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    libasan = _libasan()
+    if libasan is None:
+        pytest.skip("no libasan")
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    env = dict(
+        os.environ,
+        LD_PRELOAD=libasan,
+        # Stock CPython is not leak-clean; we gate on memory ERRORS only.
+        ASAN_OPTIONS="detect_leaks=0:abort_on_error=1",
+        BATCHAI_TPU_NATIVE_ASAN="1",
+        PYTHONPATH=os.pathsep.join(
+            p for p in (repo_root, os.environ.get("PYTHONPATH")) if p
+        ),
+    )
+    # An outer numpy-path run must not turn this gate into a failure.
+    env.pop("BATCHAI_TPU_NO_NATIVE", None)
+    proc = subprocess.run(
+        [sys.executable, "-c", _SCRIPT],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    out = proc.stdout + proc.stderr
+    assert "AddressSanitizer" not in out, out[-4000:]
+    assert proc.returncode == 0, out[-4000:]
+    assert "ASAN_DRIVE_OK" in out

@@ -90,7 +90,7 @@ class PipelineConfig:
     shard_index: int = 0
     shard_count: int = 1
     num_workers: int = 8
-    prefetch: int = 2
+    prefetch: int = 4
     drop_remainder: bool = True
     # Default: ship uint8 and normalize ON DEVICE (see normalize_images).
     # True restores the reference's host-side f32 preprocessing.

@@ -16,6 +16,7 @@ redesigned for TPU:
 """
 
 from batchai_retinanet_horovod_coco_tpu.data.coco import CocoDataset, ImageRecord
+from batchai_retinanet_horovod_coco_tpu.data.csv import CsvDataset
 from batchai_retinanet_horovod_coco_tpu.data.pipeline import (
     Batch,
     PipelineConfig,
@@ -27,6 +28,7 @@ from batchai_retinanet_horovod_coco_tpu.data.transforms import TransformConfig
 __all__ = [
     "Batch",
     "CocoDataset",
+    "CsvDataset",
     "ImageRecord",
     "PipelineConfig",
     "TransformConfig",

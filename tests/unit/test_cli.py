@@ -45,6 +45,16 @@ class TestParseArgs:
         assert args.coco_path == "/data/coco"
         assert args.train_annotations.endswith("instances_train2017.json")
 
+    def test_csv_paths(self):
+        args = parse_args(
+            ["csv", "/data/ann.csv", "/data/classes.csv",
+             "--val-csv-annotations", "/data/val.csv"]
+        )
+        assert args.csv_annotations == "/data/ann.csv"
+        assert args.csv_classes == "/data/classes.csv"
+        assert args.val_csv_annotations == "/data/val.csv"
+        assert args.image_dir is None
+
     def test_batch_not_divisible_rejected(self, tmp_path):
         from train import main
 
@@ -94,3 +104,30 @@ class TestEndToEnd:
         # Eval-only from the snapshot (preset name = BASELINE configs[4]).
         metrics = main(common + ["--preset", "eval"])
         assert "AP" in metrics or "mAP" in metrics
+
+    def test_csv_train(self, tmp_path):
+        """CLI run on a keras-retinanet-format CSV dataset."""
+        import numpy as np
+        from PIL import Image
+
+        from train import main
+
+        rng = np.random.default_rng(0)
+        for name in ("a.jpg", "b.jpg", "c.jpg", "d.jpg"):
+            Image.fromarray(
+                rng.integers(0, 255, (64, 64, 3), dtype=np.uint8)
+            ).save(tmp_path / name)
+        (tmp_path / "classes.csv").write_text("thing,0\n")
+        (tmp_path / "ann.csv").write_text(
+            "".join(f"{n},4,4,40,40,thing\n" for n in ("a.jpg", "b.jpg",
+                                                       "c.jpg", "d.jpg"))
+        )
+        out = main(
+            ["csv", str(tmp_path / "ann.csv"), str(tmp_path / "classes.csv"),
+             "--image-min-side", "64", "--image-max-side", "64",
+             "--backbone", "resnet_test", "--f32",
+             "--batch-size", "4", "--num-devices", "1",
+             "--max-gt", "8", "--workers", "2", "--steps", "2",
+             "--log-every", "1"]
+        )
+        assert out["final_step"] == 2

@@ -83,6 +83,18 @@ def build_parser() -> argparse.ArgumentParser:
     coco.add_argument("--val-annotations",
                       default="annotations/instances_val2017.json")
     coco.add_argument("--val-images", default="val2017")
+    csvp = sub.add_parser(
+        "csv", help="train on a CSV-format dataset "
+        "(keras-retinanet annotations.csv/classes.csv)", allow_abbrev=False,
+    )
+    csvp.add_argument("csv_annotations", help="annotations CSV "
+                      "(path,x1,y1,x2,y2,class_name)")
+    csvp.add_argument("csv_classes", help="classes CSV (class_name,id)")
+    csvp.add_argument("--val-csv-annotations", default=None,
+                      help="validation annotations CSV (default: none)")
+    csvp.add_argument("--image-dir", default=None,
+                      help="base dir for image paths (default: the "
+                           "annotations file's directory)")
     synth = sub.add_parser(
         "synthetic", help="generated dataset (air-gapped dev/CI path)",
         allow_abbrev=False,
@@ -92,7 +104,7 @@ def build_parser() -> argparse.ArgumentParser:
     synth.add_argument("--synthetic-classes", type=int, default=3)
     synth.add_argument("--synthetic-size", type=int, default=256)
 
-    for sp in (coco, synth):
+    for sp in (coco, csvp, synth):
         # Also accepted after the subcommand; SUPPRESS so the subparser
         # doesn't clobber a top-level --preset with its default.
         sp.add_argument("--preset", choices=sorted(PRESETS),
@@ -191,8 +203,21 @@ def parse_args(argv=None):
 def make_datasets(args):
     from batchai_retinanet_horovod_coco_tpu.data import (
         CocoDataset,
+        CsvDataset,
         make_synthetic_coco,
     )
+
+    if args.dataset_type == "csv":
+        train = CsvDataset(
+            args.csv_annotations, args.csv_classes, image_dir=args.image_dir
+        )
+        val = None
+        if args.val_csv_annotations:
+            val = CsvDataset(
+                args.val_csv_annotations, args.csv_classes,
+                image_dir=args.image_dir, keep_empty=True,
+            )
+        return train, val
 
     if args.dataset_type == "synthetic":
         size = (args.synthetic_size, args.synthetic_size)
@@ -281,6 +306,10 @@ def main(argv=None) -> dict[str, float]:
 
     train_ds, val_ds = make_datasets(args)
     num_classes = train_ds.num_classes
+    if val_ds is None and (args.eval_only or args.eval_every):
+        raise SystemExit(
+            "no validation set: pass --val-csv-annotations to evaluate"
+        )
 
     model = build_retinanet(
         RetinaNetConfig(
@@ -411,7 +440,10 @@ def main(argv=None) -> dict[str, float]:
         ),
         mesh=mesh,
         schedule=schedule,
-        eval_fn=eval_fn if (args.eval_every or args.dataset_type == "coco") else None,
+        eval_fn=eval_fn
+        if (args.eval_every or args.dataset_type == "coco"
+            or (args.dataset_type == "csv" and val_ds is not None))
+        else None,
         logger=logger,
     )
     return {"final_step": float(int(state.step))}

@@ -12,6 +12,10 @@ from batchai_retinanet_horovod_coco_tpu.evaluate.coco_eval import (
     EvalParams,
     evaluate_detections,
 )
+from batchai_retinanet_horovod_coco_tpu.evaluate.voc_eval import (
+    compute_ap,
+    evaluate_detections_voc,
+)
 from batchai_retinanet_horovod_coco_tpu.evaluate.detect import (
     DetectConfig,
     collect_detections,
@@ -27,6 +31,8 @@ __all__ = [
     "EvalParams",
     "coco_gt_from_dataset",
     "collect_detections",
+    "compute_ap",
+    "evaluate_detections_voc",
     "detections_to_coco",
     "evaluate_detections",
     "make_detect_fn",

@@ -29,6 +29,9 @@ from batchai_retinanet_horovod_coco_tpu.data import pipeline as pipeline_lib
 from batchai_retinanet_horovod_coco_tpu.data.coco import CocoDataset
 from batchai_retinanet_horovod_coco_tpu.data.pipeline import Batch
 from batchai_retinanet_horovod_coco_tpu.evaluate.coco_eval import evaluate_detections
+from batchai_retinanet_horovod_coco_tpu.evaluate.voc_eval import (
+    evaluate_detections_voc,
+)
 from batchai_retinanet_horovod_coco_tpu.ops import anchors as anchors_lib
 from batchai_retinanet_horovod_coco_tpu.ops import boxes as boxes_lib
 from batchai_retinanet_horovod_coco_tpu.ops import nms as nms_lib
@@ -216,8 +219,18 @@ def run_coco_eval(
     batches: Iterable[Batch],
     config: DetectConfig = DetectConfig(),
     mesh: Mesh | None = None,
+    voc_metrics: bool = False,
 ) -> dict[str, float]:
-    """Full eval pass: detect everything, then mAP via the numpy oracle."""
+    """Full eval pass: detect everything, then mAP via the numpy oracle.
+
+    With ``voc_metrics``, the same detection pass additionally yields
+    PASCAL-VOC AP@0.5 per class (the reference's ``Evaluate`` callback
+    metric for CSV/custom datasets, evaluate/voc_eval.py), merged into the
+    returned dict under ``voc_*`` keys.
+    """
     dt = collect_detections(state, model, dataset, batches, config, mesh=mesh)
     gt, img_ids = coco_gt_from_dataset(dataset)
-    return evaluate_detections(gt, dt, img_ids=img_ids)
+    metrics = evaluate_detections(gt, dt, img_ids=img_ids)
+    if voc_metrics:
+        metrics.update(evaluate_detections_voc(gt, dt))
+    return metrics

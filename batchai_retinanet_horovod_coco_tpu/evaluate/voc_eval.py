@@ -1,0 +1,133 @@
+"""PASCAL-VOC-style detection mAP (keras-retinanet ``Evaluate`` parity).
+
+The reference library carries a second, simpler evaluation path alongside
+CocoEval: ``utils/eval.py::evaluate`` + ``callbacks/eval.py::Evaluate``
+(SURVEY.md M13) — per-class average precision at a single IoU threshold
+(default 0.5) with all-point interpolation (the VOC2010+ method), used for
+CSV/custom datasets where COCO tooling doesn't apply.  This module rebuilds
+that metric on the same COCO-format gt/detection dicts the rest of the eval
+stack produces, so either metric runs off one detection pass.
+
+Semantics mirrored from the reference implementation:
+
+- detections per class sorted by descending score; greedy matching, each gt
+  box claimable once; a detection whose best IoU ≥ threshold against an
+  unclaimed gt is a TP, everything else (including double detections of an
+  already-claimed gt) is an FP;
+- AP = sum over recall steps of the monotone precision envelope
+  (all-point interpolation, NOT the 11-point VOC2007 variant);
+- classes with zero ground-truth annotations are excluded from the mean
+  (their AP is reported as 0 with num_annotations 0, as the reference does);
+- ``weighted_average`` weights the mean by per-class annotation counts
+  (the callback's ``weighted_average`` flag);
+- crowd ground truth (iscrowd=1) is skipped entirely — the VOC metric has no
+  ignore concept and the reference's CSV path never produces crowds.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def compute_ap(recall: np.ndarray, precision: np.ndarray) -> float:
+    """All-point interpolated AP from monotone-enveloped precision."""
+    mrec = np.concatenate([[0.0], recall, [1.0]])
+    mpre = np.concatenate([[0.0], precision, [0.0]])
+    # Monotone non-increasing envelope, right to left.
+    mpre = np.maximum.accumulate(mpre[::-1])[::-1]
+    # Sum precision over the recall steps where recall changes.
+    idx = np.flatnonzero(mrec[1:] != mrec[:-1])
+    return float(np.sum((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]))
+
+
+def _iou_matrix(dt: np.ndarray, gt: np.ndarray) -> np.ndarray:
+    """Pairwise IoU of corner boxes, (D,4) x (G,4) → (D,G)."""
+    ix1 = np.maximum(dt[:, None, 0], gt[None, :, 0])
+    iy1 = np.maximum(dt[:, None, 1], gt[None, :, 1])
+    ix2 = np.minimum(dt[:, None, 2], gt[None, :, 2])
+    iy2 = np.minimum(dt[:, None, 3], gt[None, :, 3])
+    inter = np.clip(ix2 - ix1, 0, None) * np.clip(iy2 - iy1, 0, None)
+    area_d = (dt[:, 2] - dt[:, 0]) * (dt[:, 3] - dt[:, 1])
+    area_g = (gt[:, 2] - gt[:, 0]) * (gt[:, 3] - gt[:, 1])
+    union = area_d[:, None] + area_g[None, :] - inter
+    return np.where(union > 0, inter / np.maximum(union, 1e-12), 0.0)
+
+
+def _to_corners(bbox: list[float]) -> list[float]:
+    x, y, w, h = bbox
+    return [x, y, x + w, y + h]
+
+
+def evaluate_detections_voc(
+    gt: list[dict],
+    dt: list[dict],
+    iou_threshold: float = 0.5,
+    weighted_average: bool = False,
+) -> dict[str, float]:
+    """VOC mAP over COCO-format gt annotations and detection results.
+
+    Input dicts use the same schema as the COCO oracle
+    (``evaluate/coco_eval.py``): gt has image_id/category_id/bbox
+    [x,y,w,h]/iscrowd; dt adds score.  Returns ``{"voc_mAP": float,
+    "voc_AP_<cat>": float per class with annotations}``.
+    """
+    gt_by_class: dict[int, dict[int, np.ndarray]] = {}
+    counts: dict[int, int] = {}
+    for ann in gt:
+        if ann.get("iscrowd", 0):
+            continue
+        cat, img = int(ann["category_id"]), int(ann["image_id"])
+        gt_by_class.setdefault(cat, {}).setdefault(img, []).append(
+            _to_corners(ann["bbox"])
+        )
+        counts[cat] = counts.get(cat, 0) + 1
+    for per_img in gt_by_class.values():
+        for img, boxes in per_img.items():
+            per_img[img] = np.asarray(boxes, dtype=np.float64)
+
+    dt_by_class: dict[int, list[dict]] = {}
+    for det in dt:
+        dt_by_class.setdefault(int(det["category_id"]), []).append(det)
+
+    aps: dict[int, tuple[float, int]] = {}
+    for cat, num_ann in counts.items():
+        dets = sorted(
+            dt_by_class.get(cat, ()), key=lambda d: -float(d["score"])
+        )
+        tp = np.zeros(len(dets))
+        fp = np.zeros(len(dets))
+        claimed: dict[int, np.ndarray] = {}
+        for i, det in enumerate(dets):
+            img = int(det["image_id"])
+            boxes = gt_by_class[cat].get(img)
+            if boxes is None or len(boxes) == 0:
+                fp[i] = 1
+                continue
+            ious = _iou_matrix(
+                np.asarray([_to_corners(det["bbox"])], dtype=np.float64), boxes
+            )[0]
+            j = int(np.argmax(ious))
+            taken = claimed.setdefault(img, np.zeros(len(boxes), bool))
+            if ious[j] >= iou_threshold and not taken[j]:
+                taken[j] = True
+                tp[i] = 1
+            else:
+                fp[i] = 1
+        ctp, cfp = np.cumsum(tp), np.cumsum(fp)
+        recall = ctp / num_ann
+        precision = ctp / np.maximum(ctp + cfp, 1e-12)
+        aps[cat] = (compute_ap(recall, precision), num_ann)
+
+    out: dict[str, float] = {}
+    if aps:
+        values = np.array([ap for ap, _ in aps.values()])
+        weights = np.array([n for _, n in aps.values()], dtype=np.float64)
+        if weighted_average:
+            out["voc_mAP"] = float(np.sum(values * weights) / np.sum(weights))
+        else:
+            out["voc_mAP"] = float(values.mean())
+    else:
+        out["voc_mAP"] = 0.0
+    for cat, (ap, _) in sorted(aps.items()):
+        out[f"voc_AP_{cat}"] = ap
+    return out

@@ -21,6 +21,10 @@ def main(argv: list[str] | None = None):
     for k in names:
         if k in metrics:
             print(f"{k}: {metrics[k]:.4f}")
+    # VOC metrics (CSV datasets): voc_mAP first, then per-class APs.
+    for k in sorted(metrics, key=lambda k: (k != "voc_mAP", k)):
+        if k.startswith("voc_"):
+            print(f"{k}: {metrics[k]:.4f}")
     return metrics
 
 

@@ -1,0 +1,125 @@
+"""PASCAL-VOC mAP oracle vs hand-computed fixtures.
+
+Mirrors the semantics of keras-retinanet's ``utils/eval.py::evaluate`` /
+``callbacks/eval.py::Evaluate`` (SURVEY.md M13): greedy score-ordered
+matching, one claim per gt box, all-point interpolated AP, classes without
+annotations excluded from the mean.
+"""
+
+import numpy as np
+import pytest
+
+from batchai_retinanet_horovod_coco_tpu.evaluate import (
+    compute_ap,
+    evaluate_detections_voc,
+)
+
+
+def gt_ann(img, cat, box, iscrowd=0):
+    x1, y1, x2, y2 = box
+    return {
+        "image_id": img,
+        "category_id": cat,
+        "bbox": [x1, y1, x2 - x1, y2 - y1],
+        "iscrowd": iscrowd,
+    }
+
+
+def det(img, cat, box, score):
+    x1, y1, x2, y2 = box
+    return {
+        "image_id": img,
+        "category_id": cat,
+        "bbox": [x1, y1, x2 - x1, y2 - y1],
+        "score": score,
+    }
+
+
+class TestComputeAp:
+    def test_perfect(self):
+        assert compute_ap(np.array([0.5, 1.0]), np.array([1.0, 1.0])) == 1.0
+
+    def test_no_recall(self):
+        assert compute_ap(np.array([0.0]), np.array([0.0])) == 0.0
+
+    def test_hand_computed(self):
+        # tp sequence [1, 0, 1] over 2 gts: recall [.5,.5,1], prec [1,.5,2/3].
+        # Envelope over recall steps: 0→.5 at p=1, .5→1 at p=2/3.
+        ap = compute_ap(
+            np.array([0.5, 0.5, 1.0]), np.array([1.0, 0.5, 2 / 3])
+        )
+        assert ap == pytest.approx(0.5 * 1.0 + 0.5 * 2 / 3)
+
+
+class TestEvaluateVoc:
+    def test_perfect_single_class(self):
+        gts = [gt_ann(0, 0, (0, 0, 10, 10)), gt_ann(1, 0, (5, 5, 20, 20))]
+        dts = [
+            det(0, 0, (0, 0, 10, 10), 0.9),
+            det(1, 0, (5, 5, 20, 20), 0.8),
+        ]
+        out = evaluate_detections_voc(gts, dts)
+        assert out["voc_mAP"] == pytest.approx(1.0)
+        assert out["voc_AP_0"] == pytest.approx(1.0)
+
+    def test_fp_between_tps(self):
+        gts = [gt_ann(0, 0, (0, 0, 10, 10)), gt_ann(0, 0, (50, 50, 60, 60))]
+        dts = [
+            det(0, 0, (0, 0, 10, 10), 0.9),     # TP
+            det(0, 0, (100, 100, 110, 110), 0.8),  # FP (no overlap)
+            det(0, 0, (50, 50, 60, 60), 0.7),   # TP
+        ]
+        out = evaluate_detections_voc(gts, dts)
+        assert out["voc_mAP"] == pytest.approx(0.5 + 0.5 * 2 / 3)
+
+    def test_double_detection_is_fp(self):
+        gts = [gt_ann(0, 0, (0, 0, 10, 10))]
+        dts = [
+            det(0, 0, (0, 0, 10, 10), 0.9),
+            det(0, 0, (0, 0, 10, 10), 0.8),  # same gt already claimed
+        ]
+        out = evaluate_detections_voc(gts, dts)
+        # recall [1,1], precision [1,.5] → AP 1.0 (envelope at recall step).
+        assert out["voc_mAP"] == pytest.approx(1.0)
+
+    def test_iou_threshold(self):
+        gts = [gt_ann(0, 0, (0, 0, 10, 10))]
+        # IoU = 50/150 = 1/3 against the gt.
+        dts = [det(0, 0, (5, 0, 15, 10), 0.9)]
+        assert evaluate_detections_voc(gts, dts)["voc_mAP"] == 0.0
+        out = evaluate_detections_voc(gts, dts, iou_threshold=0.3)
+        assert out["voc_mAP"] == pytest.approx(1.0)
+
+    def test_empty_class_excluded_from_mean(self):
+        gts = [gt_ann(0, 0, (0, 0, 10, 10))]  # class 1 has no gt
+        dts = [
+            det(0, 0, (0, 0, 10, 10), 0.9),
+            det(0, 1, (0, 0, 10, 10), 0.9),  # detection of gt-less class
+        ]
+        out = evaluate_detections_voc(gts, dts)
+        assert out["voc_mAP"] == pytest.approx(1.0)
+        assert "voc_AP_1" not in out
+
+    def test_weighted_average(self):
+        # class 0: 1 gt, found (AP 1); class 1: 3 gts, none found (AP 0).
+        gts = [gt_ann(0, 0, (0, 0, 10, 10))] + [
+            gt_ann(0, 1, (i * 20, 0, i * 20 + 10, 10)) for i in range(3)
+        ]
+        dts = [det(0, 0, (0, 0, 10, 10), 0.9)]
+        assert evaluate_detections_voc(gts, dts)["voc_mAP"] == pytest.approx(0.5)
+        out = evaluate_detections_voc(gts, dts, weighted_average=True)
+        assert out["voc_mAP"] == pytest.approx(0.25)
+
+    def test_crowd_skipped(self):
+        gts = [
+            gt_ann(0, 0, (0, 0, 10, 10)),
+            gt_ann(0, 0, (50, 50, 60, 60), iscrowd=1),
+        ]
+        dts = [det(0, 0, (0, 0, 10, 10), 0.9)]
+        # The crowd gt neither counts as an annotation nor absorbs matches.
+        assert evaluate_detections_voc(gts, dts)["voc_mAP"] == pytest.approx(1.0)
+
+    def test_no_gt_at_all(self):
+        assert evaluate_detections_voc([], [det(0, 0, (0, 0, 5, 5), 0.5)])[
+            "voc_mAP"
+        ] == 0.0

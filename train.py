@@ -394,7 +394,10 @@ def main(argv=None) -> dict[str, float]:
             train=False,
         )
         return run_coco_eval(
-            eval_state, model, val_ds, val_batches, detect_config, mesh=mesh
+            eval_state, model, val_ds, val_batches, detect_config, mesh=mesh,
+            # CSV datasets additionally report the reference's Evaluate-
+            # callback metric (VOC AP@0.5 per class) from the same pass.
+            voc_metrics=args.dataset_type == "csv",
         )
 
     logger = MetricLogger(args.log_dir, tensorboard=args.tensorboard)

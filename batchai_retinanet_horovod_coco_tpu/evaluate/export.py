@@ -1,0 +1,163 @@
+"""Serialized inference artifacts (the ``convert_model.py`` equivalent).
+
+The reference ships ``bin/convert_model.py`` (SURVEY.md M3): turn a training
+snapshot into a self-contained inference model (``retinanet_bbox``: forward →
+decode → clip → NMS) that runs without the training code.  In this framework
+inference is just another jitted function over the same params, so conversion
+becomes *export*: lower the full detection program (including on-device NMS)
+to serialized StableHLO via ``jax.export``, with the trained parameters baked
+in as constants.  The artifact is loadable with nothing but jax — no model
+code, no framework import — and can be lowered for several platforms at once
+(e.g. ``("cpu", "tpu")``), the analogue of the reference's one ``.h5`` that
+ran wherever Keras did.
+
+One artifact is produced per static input shape (batch, H, W) — the price of
+compiled static shapes (SURVEY.md §7.3 hard part 1); the manifest records the
+shapes so callers route images to the right program, exactly as the training
+pipeline routes into shape buckets.
+
+Layout of an export directory:
+
+    manifest.json                     shapes, detect config, class names
+    detector_<H>x<W>_b<B>.stablehlo   one serialized program per bucket
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from batchai_retinanet_horovod_coco_tpu.evaluate.detect import (
+    DetectConfig,
+    make_detect_fn,
+)
+
+_MANIFEST = "manifest.json"
+
+
+def _artifact_name(hw: tuple[int, int], batch_size: int) -> str:
+    return f"detector_{hw[0]}x{hw[1]}_b{batch_size}.stablehlo"
+
+
+def export_detector(
+    state,
+    model,
+    image_hw: tuple[int, int],
+    batch_size: int,
+    config: DetectConfig = DetectConfig(),
+    platforms: tuple[str, ...] | None = None,
+    input_dtype: Any = jnp.uint8,
+) -> bytes:
+    """Serialize one detection program (params baked in) for one bucket.
+
+    The exported callable maps ``images (B, H, W, 3) uint8`` (raw pipeline
+    format; normalization happens inside, as in training) to the Detections
+    tuple ``(boxes, scores, labels, valid)``.
+    """
+    from jax import export as jax_export
+
+    detect = make_detect_fn(model, image_hw, config)
+    # Bake the train state in as closure constants; the artifact is
+    # self-contained like the reference's converted .h5.
+    fn = jax.jit(lambda images: tuple(detect(state, images)))
+    spec = jax.ShapeDtypeStruct((batch_size, *image_hw, 3), input_dtype)
+    kwargs = {} if platforms is None else {"platforms": tuple(platforms)}
+    return jax_export.export(fn, **kwargs)(spec).serialize()
+
+
+def export_model(
+    state,
+    model,
+    output_dir: str,
+    buckets: tuple[tuple[int, int], ...],
+    batch_size: int,
+    config: DetectConfig = DetectConfig(),
+    platforms: tuple[str, ...] | None = None,
+    class_names: list[str] | None = None,
+    label_to_cat_id: dict[int, int] | None = None,
+) -> str:
+    """Export one detection artifact per shape bucket + a manifest.
+
+    Returns the manifest path.
+    """
+    os.makedirs(output_dir, exist_ok=True)
+    entries = []
+    for hw in buckets:
+        name = _artifact_name(hw, batch_size)
+        data = export_detector(
+            state, model, hw, batch_size, config, platforms=platforms
+        )
+        with open(os.path.join(output_dir, name), "wb") as f:
+            f.write(data)
+        entries.append(
+            {"file": name, "height": hw[0], "width": hw[1],
+             "batch_size": batch_size}
+        )
+    manifest = {
+        "format": "jax.export.stablehlo.v1",
+        "input": "uint8 RGB (B, H, W, 3), raw pixels (normalization inside)",
+        "output": ["boxes", "scores", "labels", "valid"],
+        "artifacts": entries,
+        "detect_config": {
+            "score_threshold": config.score_threshold,
+            "iou_threshold": config.iou_threshold,
+            "pre_nms_size": config.pre_nms_size,
+            "max_detections": config.max_detections,
+        },
+        "class_names": class_names,
+        "label_to_cat_id": (
+            {str(k): v for k, v in label_to_cat_id.items()}
+            if label_to_cat_id
+            else None
+        ),
+    }
+    path = os.path.join(output_dir, _MANIFEST)
+    with open(path, "w") as f:
+        json.dump(manifest, f, indent=2)
+    return path
+
+
+@dataclasses.dataclass
+class LoadedDetector:
+    """A deserialized export directory: shape-routed detection callables."""
+
+    manifest: dict
+    _fns: dict[tuple[int, int, int], Callable]
+
+    def buckets(self) -> list[tuple[int, int, int]]:
+        return sorted(self._fns)
+
+    def __call__(self, images: np.ndarray):
+        """Run the artifact matching ``images.shape`` exactly."""
+        b, h, w = images.shape[:3]
+        fn = self._fns.get((b, h, w))
+        if fn is None:
+            raise ValueError(
+                f"no exported program for input shape {(b, h, w)}; "
+                f"available: {self.buckets()}"
+            )
+        return fn(images)
+
+
+def load_model(output_dir: str) -> LoadedDetector:
+    """Load an export directory produced by ``export_model``.
+
+    Needs only jax — neither the model code nor the checkpoint.
+    """
+    from jax import export as jax_export
+
+    with open(os.path.join(output_dir, _MANIFEST)) as f:
+        manifest = json.load(f)
+    fns: dict[tuple[int, int, int], Callable] = {}
+    for entry in manifest["artifacts"]:
+        with open(os.path.join(output_dir, entry["file"]), "rb") as f:
+            exported = jax_export.deserialize(f.read())
+        key = (entry["batch_size"], entry["height"], entry["width"])
+        fns[key] = exported.call
+    return LoadedDetector(manifest=manifest, _fns=fns)

@@ -16,8 +16,10 @@ Semantics mirrored from the reference implementation:
   already-claimed gt) is an FP;
 - AP = sum over recall steps of the monotone precision envelope
   (all-point interpolation, NOT the 11-point VOC2007 variant);
-- classes with zero ground-truth annotations are excluded from the mean
-  (their AP is reported as 0 with num_annotations 0, as the reference does);
+- classes with zero ground-truth annotations are excluded from the mean and
+  omitted from the per-class output (this API sees only gt/detection dicts,
+  not the dataset's class universe; the reference reports such classes as
+  (0.0, 0) and likewise excludes them from its mean);
 - ``weighted_average`` weights the mean by per-class annotation counts
   (the callback's ``weighted_average`` flag);
 - crowd ground truth (iscrowd=1) is skipped entirely — the VOC metric has no

@@ -89,6 +89,24 @@ class CheckpointManager:
             opt_state=restored["opt_state"],
         )
 
+    def restore_arrays(self, step: int | None = None) -> dict[str, Any]:
+        """Restore the COMPLETE saved tree without a caller-supplied template.
+
+        For consumers that must not depend on the optimizer that produced
+        the snapshot — the export path (convert_model.py) keeps only
+        params/batch_stats/step, the inference analogue of the reference
+        loading a training ``.h5`` without recompiling its optimizer.
+
+        Note: the whole tree, opt_state included, is materialized (orbax
+        rejects partial-structure templates and ``item_metadata`` is not
+        available under this manager configuration), so this costs one full
+        checkpoint read; callers discard what they don't need.
+        """
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        return self._mgr.restore(step, args=ocp.args.StandardRestore())
+
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
 

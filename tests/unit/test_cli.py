@@ -118,9 +118,12 @@ class TestEndToEnd:
                 rng.integers(0, 255, (64, 64, 3), dtype=np.uint8)
             ).save(tmp_path / name)
         (tmp_path / "classes.csv").write_text("thing,0\n")
+        # d.jpg is an explicit negative (background-only) image — the
+        # reference CSVGenerator trains on those, and so does this path.
         (tmp_path / "ann.csv").write_text(
             "".join(f"{n},4,4,40,40,thing\n" for n in ("a.jpg", "b.jpg",
-                                                       "c.jpg", "d.jpg"))
+                                                       "c.jpg"))
+            + "d.jpg,,,,,\n"
         )
         out = main(
             ["csv", str(tmp_path / "ann.csv"), str(tmp_path / "classes.csv"),

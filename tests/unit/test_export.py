@@ -1,0 +1,91 @@
+"""Inference-artifact export/reload (the convert_model.py equivalent).
+
+The contract (SURVEY.md M3): a converted artifact must reproduce the live
+detection path — forward, decode, clip, on-device NMS — without the training
+code, like the reference's inference ``.h5``.  Round-trip equality against
+``make_detect_fn`` is the oracle.
+"""
+
+import numpy as np
+import pytest
+
+from batchai_retinanet_horovod_coco_tpu.evaluate.detect import (
+    DetectConfig,
+    make_detect_fn,
+)
+from batchai_retinanet_horovod_coco_tpu.evaluate.export import (
+    export_model,
+    load_model,
+)
+
+CONFIG = DetectConfig(pre_nms_size=64, max_detections=10)
+
+
+def test_roundtrip_matches_live_detection(tiny_model_and_state, tmp_path):
+    model, state = tiny_model_and_state
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, (2, 64, 64, 3), dtype=np.uint8)
+
+    manifest_path = export_model(
+        state, model, str(tmp_path / "exp"), buckets=((64, 64),),
+        batch_size=2, config=CONFIG, class_names=["a", "b", "c"],
+        label_to_cat_id={0: 1, 1: 2, 2: 3},
+    )
+    assert manifest_path.endswith("manifest.json")
+
+    loaded = load_model(str(tmp_path / "exp"))
+    assert loaded.buckets() == [(2, 64, 64)]
+    assert loaded.manifest["class_names"] == ["a", "b", "c"]
+
+    got = loaded(images)
+    want = make_detect_fn(model, (64, 64), CONFIG)(state, images)
+    for g, w, name in zip(got, want, ("boxes", "scores", "labels", "valid")):
+        np.testing.assert_array_equal(
+            np.asarray(g), np.asarray(w), err_msg=name
+        )
+
+
+def test_unknown_shape_rejected(tiny_model_and_state, tmp_path):
+    model, state = tiny_model_and_state
+    export_model(
+        state, model, str(tmp_path / "exp"), buckets=((64, 64),),
+        batch_size=2, config=CONFIG,
+    )
+    loaded = load_model(str(tmp_path / "exp"))
+    with pytest.raises(ValueError, match="no exported program"):
+        loaded(np.zeros((1, 64, 64, 3), dtype=np.uint8))
+
+
+@pytest.mark.slow
+def test_convert_model_cli(tiny_model_and_state, tmp_path, monkeypatch):
+    """End-to-end: train 1 step with snapshots, convert, reload, run."""
+    import sys
+
+    sys.path.insert(0, "/root/repo")
+    import convert_model
+    from train import main as train_main
+
+    train_main(
+        ["synthetic",
+         "--synthetic-root", str(tmp_path / "data"),
+         "--synthetic-images", "4", "--synthetic-size", "64",
+         "--image-min-side", "64", "--image-max-side", "64",
+         "--backbone", "resnet_test", "--f32",
+         "--batch-size", "2", "--num-devices", "1",
+         "--max-gt", "8", "--workers", "2", "--steps", "1",
+         "--snapshot-path", str(tmp_path / "ckpt"),
+         "--checkpoint-every", "1"]
+    )
+    manifest = convert_model.main(
+        ["--snapshot-path", str(tmp_path / "ckpt"),
+         "--output", str(tmp_path / "exp"),
+         "--num-classes", "3", "--backbone", "resnet_test", "--f32",
+         "--image-min-side", "64", "--image-max-side", "64",
+         "--batch-size", "2"]
+    )
+    loaded = load_model(str(tmp_path / "exp"))
+    boxes, scores, labels, valid = loaded(
+        np.zeros((2, 64, 64, 3), dtype=np.uint8)
+    )
+    assert np.asarray(boxes).shape[0] == 2
+    assert np.asarray(valid).dtype == bool

@@ -208,8 +208,11 @@ def make_datasets(args):
     )
 
     if args.dataset_type == "csv":
+        # keep_empty: explicit 'path,,,,,' rows are intentional negative
+        # (background-only) images; the reference CSVGenerator trains on them.
         train = CsvDataset(
-            args.csv_annotations, args.csv_classes, image_dir=args.image_dir
+            args.csv_annotations, args.csv_classes, image_dir=args.image_dir,
+            keep_empty=True,
         )
         val = None
         if args.val_csv_annotations:

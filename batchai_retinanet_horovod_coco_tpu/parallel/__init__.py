@@ -21,10 +21,20 @@ from batchai_retinanet_horovod_coco_tpu.parallel.mesh import (
     make_mesh,
     replicated_sharding,
 )
+from batchai_retinanet_horovod_coco_tpu.parallel.zero import (
+    clip_by_global_norm_sharded,
+    init_sharded_opt_state,
+    opt_state_partition_specs,
+    sharded_update,
+)
 
 __all__ = [
     "DATA_AXIS",
     "batch_sharding",
+    "clip_by_global_norm_sharded",
+    "init_sharded_opt_state",
     "make_mesh",
+    "opt_state_partition_specs",
     "replicated_sharding",
+    "sharded_update",
 ]

@@ -85,6 +85,7 @@ def run_training(
     schedule: Callable[[int], float] | None = None,
     eval_fn: Callable[[TrainState], dict[str, float]] | None = None,
     logger: MetricLogger | None = None,
+    shard_weight_update: bool = False,
 ) -> TrainState:
     """Run ``config.total_steps`` of SPMD training; returns the final state.
 
@@ -106,8 +107,30 @@ def run_training(
 
     if mesh is not None:
         # Replicate state over the mesh (restored arrays land committed to a
-        # single device, which conflicts with the shard_map'd step).
-        state = jax.device_put(state, replicated_sharding(mesh))
+        # single device, which conflicts with the shard_map'd step).  In
+        # weight-update-sharded mode the opt_state leaves keep their 1/N
+        # layout on the data axis instead (parallel/zero.py storage format).
+        if shard_weight_update:
+            from jax.sharding import NamedSharding
+
+            from batchai_retinanet_horovod_coco_tpu.parallel.zero import (
+                opt_state_partition_specs,
+            )
+
+            rep = replicated_sharding(mesh)
+            opt_state = jax.tree.map(
+                lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+                state.opt_state,
+                opt_state_partition_specs(state.opt_state),
+            )
+            state = state.replace(
+                step=jax.device_put(state.step, rep),
+                params=jax.device_put(state.params, rep),
+                batch_stats=jax.device_put(state.batch_stats, rep),
+                opt_state=opt_state,
+            )
+        else:
+            state = jax.device_put(state, replicated_sharding(mesh))
 
     step_fns: dict[tuple[int, int], Callable] = {}
     start_step = int(state.step)
@@ -136,6 +159,7 @@ def run_training(
                 mesh=mesh,
                 loss_config=loss_config,
                 matching_config=matching_config,
+                shard_weight_update=shard_weight_update,
             )
         if config.profile_dir and step == prof_start:
             jax.profiler.start_trace(config.profile_dir)

@@ -84,8 +84,16 @@ def make_schedule(config: OptimizerConfig) -> optax.Schedule:
 
 def make_optimizer(
     config: OptimizerConfig,
+    shard_clip_axis: str | None = None,
 ) -> tuple[optax.GradientTransformation, optax.Schedule]:
-    """(transform, schedule) — schedule returned separately for logging."""
+    """(transform, schedule) — schedule returned separately for logging.
+
+    ``shard_clip_axis``: name of the mesh axis the updates are sharded over
+    (weight-update-sharded mode, parallel/zero.py).  The chain then uses
+    ``clip_by_global_norm_sharded`` — same clip rule, norm psum-ed across
+    shards — in the SAME chain position, so freeze-masking applies to it
+    identically.  The clip value has exactly one source: this config.
+    """
     schedule = make_schedule(config)
     if config.optimizer == "sgd":
         core = optax.chain(
@@ -97,8 +105,17 @@ def make_optimizer(
     else:
         raise ValueError(f"unknown optimizer: {config.optimizer!r}")
 
-    parts = [optax.clip_by_global_norm(config.clip_global_norm), core]
-    tx = optax.chain(*parts)
+    if shard_clip_axis is not None:
+        from batchai_retinanet_horovod_coco_tpu.parallel.zero import (
+            clip_by_global_norm_sharded,
+        )
+
+        clip = clip_by_global_norm_sharded(
+            config.clip_global_norm, shard_clip_axis
+        )
+    else:
+        clip = optax.clip_by_global_norm(config.clip_global_norm)
+    tx = optax.chain(clip, core)
 
     if config.freeze_backbone:
         # Zero gradients for the backbone subtree (reference --freeze-backbone).

@@ -84,12 +84,17 @@ def create_train_state(
     tx: optax.GradientTransformation,
     example_image_shape: tuple[int, int, int, int],
     rng: jax.Array,
+    init_opt_state: bool = True,
 ) -> TrainState:
     """Initialize params; identical on every process (same PRNG key).
 
     ``model.init`` is wrapped in jit: eager init dispatches thousands of tiny
     ops, which is pathological on remote/tunneled TPU backends (measured
     ~4 min eager vs seconds jitted for ResNet-50).
+
+    ``init_opt_state=False`` leaves ``opt_state`` empty: weight-update-
+    sharded mode (parallel/zero.py) initializes its 1/N layout directly and
+    must not pay the peak memory of a throwaway replicated ``tx.init``.
     """
     variables = jax.jit(model.init)(rng, jnp.zeros(example_image_shape, jnp.float32))
     params = variables["params"]
@@ -98,6 +103,6 @@ def create_train_state(
         step=jnp.zeros((), jnp.int32),
         params=params,
         batch_stats=batch_stats,
-        opt_state=tx.init(params),
+        opt_state=tx.init(params) if init_opt_state else (),
         tx=tx,
     )

@@ -94,6 +94,7 @@ def make_train_step(
     matching_config: matching_lib.MatchingConfig = matching_lib.MatchingConfig(),
     anchor_config: anchors_lib.AnchorConfig | None = None,
     donate_state: bool = True,
+    shard_weight_update: bool = False,
 ) -> Callable[[TrainState, dict[str, Any]], tuple[TrainState, dict[str, jnp.ndarray]]]:
     """Build the jitted train step for one shape bucket.
 
@@ -104,10 +105,20 @@ def make_train_step(
 
     Without ``mesh``: plain single-device jit (BASELINE.json configs[1]).
 
+    ``shard_weight_update`` (requires ``mesh``): ZeRO-style mode — gradients
+    reduce-scatter instead of all-reduce, each device updates its 1/N of the
+    params with its 1/N optimizer-state shard, updated params all-gather
+    back (parallel/zero.py).  ``state.opt_state`` must come from
+    ``init_sharded_opt_state`` and ``state.tx`` from
+    ``make_optimizer(..., shard_clip_axis=DATA_AXIS)`` so gradient clipping
+    uses the global (cross-shard) norm.
+
     The returned callable takes (state, batch_dict) where batch_dict holds
     ``images, gt_boxes, gt_labels, gt_mask`` (leading axis = GLOBAL batch)
     and returns (new_state, metrics).
     """
+    if shard_weight_update and mesh is None:
+        raise ValueError("shard_weight_update requires a mesh")
     anchors = jnp.asarray(
         anchors_lib.anchors_for_image_shape(image_hw, anchor_config or anchors_lib.AnchorConfig())
     )
@@ -137,6 +148,73 @@ def make_train_step(
         return train_step
 
     batch_spec = {k: P(DATA_AXIS) for k in ("images", "gt_boxes", "gt_labels", "gt_mask")}
+
+    if shard_weight_update:
+        from batchai_retinanet_horovod_coco_tpu.parallel import zero
+
+        def reduce_metrics(metrics):
+            num_pos = lax.psum(metrics["num_pos"], DATA_AXIS)
+            metrics = lax.pmean(metrics, DATA_AXIS)
+            metrics["num_pos"] = num_pos
+            return metrics
+
+        def state_specs(state: TrainState) -> TrainState:
+            """Per-leaf spec tree: everything replicated except opt_state."""
+            return TrainState(
+                step=P(),
+                params=jax.tree.map(lambda _: P(), state.params),
+                batch_stats=jax.tree.map(lambda _: P(), state.batch_stats),
+                opt_state=zero.opt_state_partition_specs(state.opt_state),
+                tx=state.tx,
+            )
+
+        def make_zero_step(state_template: TrainState):
+            specs = state_specs(state_template)
+
+            @partial(
+                shard_map,
+                mesh=mesh,
+                in_specs=(specs, batch_spec),
+                out_specs=(specs, P()),
+                check_vma=False,
+            )
+            def zero_step(state: TrainState, batch: dict[str, Any]):
+                grads, metrics, new_bs = local_step(state, batch)
+                metrics = reduce_metrics(metrics)
+                if state.batch_stats:
+                    new_bs = lax.pmean(new_bs, DATA_AXIS)
+                # Reduce-scatter + sharded update + all_gather replaces the
+                # pmean-allreduce + replicated update (parallel/zero.py).
+                new_params, new_opt = zero.sharded_update(
+                    state.tx,
+                    grads,
+                    state.opt_state,
+                    state.params,
+                    n=mesh.size,
+                    loss_value=metrics["loss"],
+                )
+                new_state = state.replace(
+                    step=state.step + 1,
+                    params=new_params,
+                    batch_stats=new_bs,
+                    opt_state=new_opt,
+                )
+                return new_state, metrics
+
+            return jax.jit(
+                zero_step, donate_argnums=(0,) if donate_state else ()
+            )
+
+        # The spec tree depends on the opt_state structure, which only the
+        # caller's state knows — build lazily on first call and cache.
+        cache: dict[str, Callable] = {}
+
+        def zero_entry(state: TrainState, batch: dict[str, Any]):
+            if "fn" not in cache:
+                cache["fn"] = make_zero_step(state)
+            return cache["fn"](state, batch)
+
+        return zero_entry
 
     @partial(
         shard_map,

@@ -177,6 +177,10 @@ def build_parser() -> argparse.ArgumentParser:
                        help="cpu: run the full SPMD path on a virtual CPU "
                             "mesh of --num-devices (CI / laptops, "
                             "SURVEY.md §7.3); auto: default backend")
+        g.add_argument("--shard-weight-update", action="store_true",
+                       help="ZeRO-style weight-update sharding: "
+                            "reduce-scatter grads, 1/N optimizer state per "
+                            "device, all_gather params (SURVEY.md §2.4)")
         g.add_argument("--distributed-auto", action="store_true",
                        help="jax.distributed.initialize() from TPU metadata")
         g.add_argument("--coordinator-address", default=None)
@@ -337,12 +341,30 @@ def main(argv=None) -> dict[str, float]:
         weight_decay=args.weight_decay,
         freeze_backbone=args.freeze_backbone,
     )
-    tx, schedule = make_optimizer(opt_config)
+    shard_update = bool(getattr(args, "shard_weight_update", False))
+    if shard_update and num_devices <= 1:
+        raise SystemExit("--shard-weight-update needs --num-devices > 1")
+    # Sharded-update mode swaps in the cross-shard global-norm clip — same
+    # chain position, same clip value, one source of truth (parallel/zero.py).
+    from batchai_retinanet_horovod_coco_tpu.parallel.mesh import DATA_AXIS
+
+    tx, schedule = make_optimizer(
+        opt_config, shard_clip_axis=DATA_AXIS if shard_update else None
+    )
     buckets = default_buckets(args.image_min_side, args.image_max_side)
     init_hw = buckets[0]
     state = create_train_state(
-        model, tx, (1, *init_hw, 3), jax.random.key(args.seed)
+        model, tx, (1, *init_hw, 3), jax.random.key(args.seed),
+        init_opt_state=not shard_update,
     )
+    if shard_update:
+        from batchai_retinanet_horovod_coco_tpu.parallel import (
+            init_sharded_opt_state,
+        )
+
+        state = state.replace(
+            opt_state=init_sharded_opt_state(tx, state.params, mesh)
+        )
     if args.pretrained_backbone:
         from batchai_retinanet_horovod_coco_tpu.models.import_weights import (
             apply_backbone_weights,
@@ -446,6 +468,7 @@ def main(argv=None) -> dict[str, float]:
         ),
         mesh=mesh,
         schedule=schedule,
+        shard_weight_update=shard_update,
         eval_fn=eval_fn
         if (args.eval_every or args.dataset_type == "coco"
             or (args.dataset_type == "csv" and val_ds is not None))

@@ -33,6 +33,11 @@ class RetinaNetConfig:
     num_classes: int = 80
     backbone: str = "resnet50"
     norm_kind: str = "gn"  # "gn" | "bn" | "frozen_bn"  (see models/resnet.py)
+    # Stem formulation (models/resnet.py StemConv): space_to_depth is the
+    # MLPerf-equivalent reformulation of the 7x7/2 conv — identical math,
+    # measured 3.7% faster end-to-end on v5e (the plain 3-channel stem runs
+    # the MXU at ~4% occupancy).  "conv" restores the canonical form.
+    stem: str = "space_to_depth"
     fpn_channels: int = 256
     head_width: int = 256
     head_depth: int = 4
@@ -84,6 +89,7 @@ class RetinaNet(nn.Module):
                 stage_sizes=stages,
                 norm_kind=cfg.norm_kind,
                 dtype=cfg.dtype,
+                stem=cfg.stem,
                 name="backbone",
             )(images, train=train)
         with jax.named_scope("fpn"):

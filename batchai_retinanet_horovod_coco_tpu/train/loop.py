@@ -102,7 +102,17 @@ def run_training(
             save_interval_steps=config.checkpoint_every,
         )
         if config.resume and ckpt.latest_step() is not None:
-            state = ckpt.restore(state)
+            try:
+                state = ckpt.restore(state)
+            except Exception as e:
+                raise RuntimeError(
+                    f"restoring {config.checkpoint_dir} failed. A sharded-"
+                    "update checkpoint (--shard-weight-update) cannot resume "
+                    "in replicated mode or on a different device count, and "
+                    "vice versa — the optimizer-state layouts differ "
+                    "(parallel/zero.py). Re-run with the original mode/"
+                    "topology or start fresh with --no-resume."
+                ) from e
             print(f"resumed from step {int(state.step)}", flush=True)
 
     if mesh is not None:

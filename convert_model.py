@@ -34,6 +34,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--backbone", default="resnet50",
                    choices=["resnet50", "resnet101", "resnet152", "resnet_test"])
     p.add_argument("--norm", default="gn", choices=["gn", "bn", "frozen_bn"])
+    p.add_argument("--stem", default="space_to_depth",
+                   choices=["conv", "space_to_depth"],
+                   help="stem formulation (param layout is identical; "
+                        "either loads any snapshot)")
     p.add_argument("--f32", action="store_true",
                    help="compute in float32 (default bfloat16)")
     p.add_argument("--batch-size", type=int, default=1)
@@ -83,6 +87,7 @@ def main(argv: list[str] | None = None) -> str:
             num_classes=args.num_classes,
             backbone=args.backbone,
             norm_kind=args.norm,
+            stem=args.stem,
             dtype=jnp.float32 if args.f32 else jnp.bfloat16,
         )
     )

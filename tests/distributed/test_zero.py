@@ -6,10 +6,7 @@ same training trajectory as the replicated pmean step — the only allowed
 divergence is float reduction order.
 """
 
-import dataclasses
-
 import jax
-import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest

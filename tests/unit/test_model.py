@@ -124,3 +124,71 @@ def test_return_levels_concat_equals_default(tiny_model_and_state):
         np.asarray(jnp.concatenate(levels["box_levels"], axis=1)),
         np.asarray(flat["box_deltas"]), rtol=1e-6,
     )
+
+
+class TestSpaceToDepthStem:
+    """The MLPerf s2d stem must be EXACTLY the 7x7/2 conv, reformulated."""
+
+    def test_equivalent_to_plain_stem(self):
+        from batchai_retinanet_horovod_coco_tpu.models.resnet import StemConv
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(0, 1, (2, 64, 96, 3)).astype(np.float32))
+        plain = StemConv(space_to_depth=False, dtype=jnp.float32)
+        s2d = StemConv(space_to_depth=True, dtype=jnp.float32)
+        params = plain.init(jax.random.key(0), x)  # SAME (7,7,3,64) param
+        a = jax.jit(plain.apply)(params, x)
+        b = jax.jit(s2d.apply)(params, x)
+        assert a.shape == b.shape == (2, 32, 48, 64)
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5
+        )
+
+    def test_param_layout_is_mode_independent(self):
+        """Checkpoints / torch imports see (7,7,3,64) in both modes."""
+        from batchai_retinanet_horovod_coco_tpu.models.resnet import StemConv
+
+        x = jnp.zeros((1, 32, 32, 3), jnp.float32)
+        for mode in (False, True):
+            params = StemConv(space_to_depth=mode).init(jax.random.key(0), x)
+            assert params["params"]["kernel"].shape == (7, 7, 3, 64)
+
+    def test_odd_shape_rejected(self):
+        from batchai_retinanet_horovod_coco_tpu.models.resnet import StemConv
+
+        x = jnp.zeros((1, 33, 32, 3), jnp.float32)
+        with pytest.raises(ValueError, match="even"):
+            StemConv(space_to_depth=True).init(jax.random.key(0), x)
+
+    def test_plain_stem_same_padding_odd_dims(self):
+        """conv mode keeps nn.Conv's SAME rule: out = ceil(d/2), odd dims too."""
+        from batchai_retinanet_horovod_coco_tpu.models.resnet import StemConv
+
+        x = jnp.zeros((1, 33, 47, 3), jnp.float32)
+        m = StemConv(space_to_depth=False, dtype=jnp.float32)
+        out = m.apply(m.init(jax.random.key(0), x), x)
+        assert out.shape == (1, 17, 24, 64)
+
+    def test_full_model_equivalence(self):
+        """Whole-model outputs match between stem modes with shared params."""
+        cfg = dict(
+            num_classes=3, backbone="resnet_test", fpn_channels=32,
+            head_width=32, head_depth=1, dtype=jnp.float32,
+        )
+        plain = build_retinanet(RetinaNetConfig(**cfg))
+        s2d = build_retinanet(RetinaNetConfig(stem="space_to_depth", **cfg))
+        x = jnp.asarray(
+            np.random.default_rng(0).normal(0, 1, (1, 64, 64, 3)),
+            jnp.float32,
+        )
+        params = plain.init(jax.random.key(0), x)
+        a = jax.jit(lambda p, x: plain.apply(p, x, train=False))(params, x)
+        b = jax.jit(lambda p, x: s2d.apply(p, x, train=False))(params, x)
+        np.testing.assert_allclose(
+            np.asarray(a["cls_logits"]), np.asarray(b["cls_logits"]),
+            rtol=1e-4, atol=1e-4,
+        )
+        np.testing.assert_allclose(
+            np.asarray(a["box_deltas"]), np.asarray(b["box_deltas"]),
+            rtol=1e-4, atol=1e-4,
+        )

@@ -160,20 +160,25 @@ def sharded_update(
     *,
     n: int,
     loss_value: jnp.ndarray | None = None,
-) -> tuple[Any, Any]:
+) -> tuple[Any, Any, dict[str, jnp.ndarray]]:
     """One weight update on this device's shard; call INSIDE shard_map.
 
     ``grads`` are the local per-device gradients (pre-allreduce); the
     reduce-scatter happens here.  Gradient clipping is ``tx``'s concern:
     build the chain with ``clip_by_global_norm_sharded`` (train/optim.py
     ``shard_clip_axis``) so the norm is global across shards.  Returns
-    (new_params FULL via all_gather, new_opt_state local shards).
+    (new_params FULL via all_gather, new_opt_state local shards,
+    info dict with the pre-clip ``grad_norm`` — SURVEY.md §5.5 metric).
     """
     index = lax.axis_index(DATA_AXIS)
     gshards = jax.tree.map(
         lambda g: lax.psum_scatter(_pad_flat(g, n), DATA_AXIS, tiled=True) / n,
         grads,
     )
+    # The shards partition the mean gradient exactly (padding is zeros), so
+    # the global norm is the psum of per-shard square sums.
+    sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(gshards))
+    info = {"grad_norm": jnp.sqrt(lax.psum(sq, DATA_AXIS))}
     pshards = jax.tree.map(lambda p: _local_shard(p, n, index), params)
     if loss_value is not None and isinstance(
         tx, optax.GradientTransformationExtraArgs
@@ -185,4 +190,4 @@ def sharded_update(
         updates, new_opt_state = tx.update(gshards, opt_state, pshards)
     new_pshards = optax.apply_updates(pshards, updates)
     new_params = jax.tree.map(_unshard, new_pshards, params)
-    return new_params, new_opt_state
+    return new_params, new_opt_state, info

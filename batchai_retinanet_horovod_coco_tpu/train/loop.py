@@ -106,12 +106,14 @@ def run_training(
                 state = ckpt.restore(state)
             except Exception as e:
                 raise RuntimeError(
-                    f"restoring {config.checkpoint_dir} failed. A sharded-"
-                    "update checkpoint (--shard-weight-update) cannot resume "
-                    "in replicated mode or on a different device count, and "
+                    f"restoring {config.checkpoint_dir} failed (root cause "
+                    "in the chained traceback). If the shapes/tree mismatch: "
+                    "a --shard-weight-update checkpoint cannot resume in "
+                    "replicated mode or on a different device count, and "
                     "vice versa — the optimizer-state layouts differ "
-                    "(parallel/zero.py). Re-run with the original mode/"
-                    "topology or start fresh with --no-resume."
+                    "(parallel/zero.py); re-run with the original mode/"
+                    "topology. Otherwise the checkpoint may be incomplete "
+                    "or corrupt — start fresh with --no-resume."
                 ) from e
             print(f"resumed from step {int(state.step)}", flush=True)
 
@@ -154,11 +156,16 @@ def run_training(
     prof_end = min(config.total_steps, prof_start + config.profile_steps - 1)
     window_t0 = time.perf_counter()
     window_images = 0
+    window_data_wait = 0.0  # host time blocked on the input pipeline
+    window_steps = 0
     metrics = None
     it: Iterator[Batch] = iter(batches)
 
     for step in range(start_step + 1, config.total_steps + 1):
+        t_data = time.perf_counter()
         batch = next(it)
+        window_data_wait += time.perf_counter() - t_data
+        window_steps += 1
         hw = batch.images.shape[1:3]
         step_fn = step_fns.get(hw)
         if step_fn is None:
@@ -191,6 +198,13 @@ def run_training(
             scalars = {k: v for k, v in jax.device_get(metrics).items()}
             dt = time.perf_counter() - window_t0
             scalars["images_per_sec"] = window_images / max(dt, 1e-9)
+            # Step-time breakdown (SURVEY.md §5.5): how much of the step the
+            # host spent BLOCKED on the input pipeline — the classic
+            # detection scaling-efficiency killer (SURVEY.md §7.3 part 6).
+            scalars["step_time_ms"] = dt / max(window_steps, 1) * 1e3
+            scalars["data_wait_ms"] = (
+                window_data_wait / max(window_steps, 1) * 1e3
+            )
             if schedule is not None:
                 scalars["lr"] = float(schedule(step - 1))
                 scale = optim.plateau_scale(state.opt_state)
@@ -199,6 +213,8 @@ def run_training(
             logger.log(step, scalars)
             window_t0 = time.perf_counter()
             window_images = 0
+            window_data_wait = 0.0
+            window_steps = 0
 
         if ckpt is not None and ckpt.save(state, step=step):
             last_saved = step
@@ -210,6 +226,11 @@ def run_training(
             and step < config.total_steps
         ):
             logger.log(step, eval_fn(state), prefix="eval")
+            # Eval time must not pollute the next window's step-time metrics.
+            window_t0 = time.perf_counter()
+            window_images = 0
+            window_data_wait = 0.0
+            window_steps = 0
 
     final_step = max(start_step, config.total_steps)
     if eval_fn is not None:

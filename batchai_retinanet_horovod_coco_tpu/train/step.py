@@ -22,6 +22,7 @@ from typing import Any, Callable
 import jax
 import jax.numpy as jnp
 import numpy as np
+import optax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
@@ -140,6 +141,8 @@ def make_train_step(
         @partial(jax.jit, donate_argnums=(0,) if donate_state else ())
         def train_step(state: TrainState, batch: dict[str, Any]):
             grads, metrics, new_bs = local_step(state, batch)
+            # SURVEY.md §5.5: grad-norm is a first-class per-step metric.
+            metrics["grad_norm"] = optax.global_norm(grads)
             new_state = state.apply_gradients(
                 grads, new_bs, loss_value=metrics["loss"]
             )
@@ -185,7 +188,7 @@ def make_train_step(
                     new_bs = lax.pmean(new_bs, DATA_AXIS)
                 # Reduce-scatter + sharded update + all_gather replaces the
                 # pmean-allreduce + replicated update (parallel/zero.py).
-                new_params, new_opt = zero.sharded_update(
+                new_params, new_opt, info = zero.sharded_update(
                     state.tx,
                     grads,
                     state.opt_state,
@@ -193,6 +196,7 @@ def make_train_step(
                     n=mesh.size,
                     loss_value=metrics["loss"],
                 )
+                metrics.update(info)
                 new_state = state.replace(
                     step=state.step + 1,
                     params=new_params,
@@ -230,6 +234,7 @@ def make_train_step(
         num_pos = lax.psum(metrics["num_pos"], DATA_AXIS)  # a count, not a mean
         metrics = lax.pmean(metrics, DATA_AXIS)
         metrics["num_pos"] = num_pos
+        metrics["grad_norm"] = optax.global_norm(grads)
         if state.batch_stats:
             new_bs = lax.pmean(new_bs, DATA_AXIS)  # sync-BN semantics
         new_state = state.apply_gradients(

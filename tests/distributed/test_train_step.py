@@ -128,3 +128,22 @@ def test_mesh_subset_sizes():
     step = make_train_step(model, HW, NUM_CLASSES, mesh=mesh, donate_state=False)
     _, metrics = step(state, synthetic_batch(seed=7))
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_grad_norm_metric(model_and_state):
+    """SURVEY.md §5.5: grad-norm is reported per step, sharded == single."""
+    from batchai_retinanet_horovod_coco_tpu.parallel import make_mesh
+
+    model, state = model_and_state
+    batch = synthetic_batch(0)
+    single = make_train_step(model, HW, NUM_CLASSES, donate_state=False)
+    _, m1 = single(state, batch)
+    mesh = make_mesh(8)
+    sharded = make_train_step(
+        model, HW, NUM_CLASSES, mesh=mesh, donate_state=False
+    )
+    _, m8 = sharded(state, batch)
+    assert float(m1["grad_norm"]) > 0
+    np.testing.assert_allclose(
+        float(m8["grad_norm"]), float(m1["grad_norm"]), rtol=1e-4
+    )

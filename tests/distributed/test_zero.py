@@ -96,6 +96,11 @@ def test_matches_replicated_step(opt_config):
     np.testing.assert_allclose(
         float(zm["loss"]), float(m["loss"]), rtol=1e-5
     )
+    # The zero path's hand-rolled norm (psum of per-shard square sums over
+    # zero-padded flat shards) must equal the replicated optax.global_norm.
+    np.testing.assert_allclose(
+        float(zm["grad_norm"]), float(m["grad_norm"]), rtol=1e-4
+    )
     ref = jax.tree.leaves(state.params)
     got = jax.tree.leaves(sharded_state.params)
     # Adam's g/(sqrt(g^2)+eps) update amplifies reduction-order noise

@@ -17,6 +17,10 @@ redesigned for TPU:
 
 from batchai_retinanet_horovod_coco_tpu.data.coco import CocoDataset, ImageRecord
 from batchai_retinanet_horovod_coco_tpu.data.csv import CsvDataset
+from batchai_retinanet_horovod_coco_tpu.data.pascal_voc import (
+    VOC_CLASSES,
+    PascalVocDataset,
+)
 from batchai_retinanet_horovod_coco_tpu.data.pipeline import (
     Batch,
     PipelineConfig,
@@ -30,7 +34,9 @@ __all__ = [
     "CocoDataset",
     "CsvDataset",
     "ImageRecord",
+    "PascalVocDataset",
     "PipelineConfig",
+    "VOC_CLASSES",
     "TransformConfig",
     "build_pipeline",
     "make_synthetic_coco",

@@ -1,0 +1,117 @@
+"""Pascal VOC dataset source (keras-retinanet PascalVocGenerator parity).
+
+Hand-built VOCdevkit tree: XML parsing (1-based coords), the canonical
+20-class mapping, difficult-object routing to the ignore channel, and
+pipeline plug-compatibility.
+"""
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from batchai_retinanet_horovod_coco_tpu.data import (
+    VOC_CLASSES,
+    PascalVocDataset,
+    PipelineConfig,
+    build_pipeline,
+)
+
+
+def obj_xml(name, xmin, ymin, xmax, ymax, difficult=0):
+    return (
+        f"<object><name>{name}</name><difficult>{difficult}</difficult>"
+        f"<bndbox><xmin>{xmin}</xmin><ymin>{ymin}</ymin>"
+        f"<xmax>{xmax}</xmax><ymax>{ymax}</ymax></bndbox></object>"
+    )
+
+
+def write_example(root, vid, size, objects):
+    w, h = size
+    (root / "Annotations" / f"{vid}.xml").write_text(
+        f"<annotation><filename>{vid}.jpg</filename>"
+        f"<size><width>{w}</width><height>{h}</height><depth>3</depth></size>"
+        + "".join(objects)
+        + "</annotation>"
+    )
+    rng = np.random.default_rng(abs(hash(vid)) % 2**32)
+    Image.fromarray(
+        rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+    ).save(root / "JPEGImages" / f"{vid}.jpg")
+
+
+@pytest.fixture(scope="module")
+def voc_root(tmp_path_factory):
+    root = tmp_path_factory.mktemp("VOC2007")
+    for d in ("Annotations", "JPEGImages", "ImageSets/Main"):
+        (root / d).mkdir(parents=True)
+    write_example(
+        root, "000001", (64, 48),
+        [obj_xml("dog", 10, 11, 40, 41), obj_xml("person", 1, 1, 20, 20)],
+    )
+    write_example(
+        root, "000002", (48, 64),
+        [obj_xml("cat", 5, 5, 30, 30, difficult=1)],
+    )
+    write_example(root, "000003", (32, 32), [])
+    (root / "ImageSets/Main/trainval.txt").write_text(
+        "000001\n000002\n000003\n"
+    )
+    return root
+
+
+def test_parse_and_class_mapping(voc_root):
+    ds = PascalVocDataset(str(voc_root), split="trainval")
+    assert ds.num_classes == 20
+    assert ds.class_names == list(VOC_CLASSES)
+    rec = ds.records[0]
+    # 1-based → the reference subtracts 1 from all four coordinates.
+    np.testing.assert_allclose(rec.boxes[0], [9, 10, 39, 40])
+    assert rec.labels[0] == VOC_CLASSES.index("dog")
+    assert rec.labels[1] == VOC_CLASSES.index("person")
+    assert rec.width == 64 and rec.height == 48
+
+
+def test_difficult_routed_to_ignore(voc_root):
+    ds = PascalVocDataset(str(voc_root), split="trainval")
+    # 000002 has ONLY a difficult object → no training boxes → dropped
+    # unless keep_empty; with keep_empty it carries the ignore box.
+    assert [r.file_name for r in ds.records] == ["000001.jpg"]
+    ds = PascalVocDataset(str(voc_root), split="trainval", keep_empty=True)
+    rec2 = next(r for r in ds.records if r.file_name == "000002.jpg")
+    assert len(rec2.boxes) == 0
+    assert len(rec2.crowd_boxes) == 1
+    assert rec2.crowd_labels[0] == VOC_CLASSES.index("cat")
+
+
+def test_skip_difficult(voc_root):
+    ds = PascalVocDataset(
+        str(voc_root), split="trainval", skip_difficult=True, keep_empty=True
+    )
+    rec2 = next(r for r in ds.records if r.file_name == "000002.jpg")
+    assert len(rec2.boxes) == 0 and len(rec2.crowd_boxes) == 0
+
+
+def test_unknown_class_rejected(voc_root, tmp_path):
+    import shutil
+
+    root = tmp_path / "voc"
+    shutil.copytree(voc_root, root)
+    write_example(root, "000009", (32, 32), [obj_xml("dragon", 1, 1, 10, 10)])
+    (root / "ImageSets/Main/trainval.txt").write_text("000009\n")
+    with pytest.raises(ValueError, match="unknown class"):
+        PascalVocDataset(str(root), split="trainval")
+
+
+def test_pipeline_compatibility(voc_root):
+    ds = PascalVocDataset(str(voc_root), split="trainval", keep_empty=True)
+    batches = build_pipeline(
+        ds,
+        PipelineConfig(
+            batch_size=3, buckets=((96, 96),), min_side=64, max_side=96,
+            max_gt=10, num_workers=2, shuffle=False,
+        ),
+        train=False,
+    )
+    batch = next(iter(batches))
+    assert batch.images.shape == (3, 96, 96, 3)
+    assert batch.gt_mask.sum() == 2  # only 000001's two real boxes

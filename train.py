@@ -95,6 +95,17 @@ def build_parser() -> argparse.ArgumentParser:
     csvp.add_argument("--image-dir", default=None,
                       help="base dir for image paths (default: the "
                            "annotations file's directory)")
+    pascal = sub.add_parser(
+        "pascal", help="train on a Pascal VOC dataset (VOCdevkit layout)",
+        allow_abbrev=False,
+    )
+    pascal.add_argument("pascal_path", help="VOCdevkit year root "
+                        "(contains Annotations/, JPEGImages/, ImageSets/)")
+    pascal.add_argument("--train-split", default="trainval")
+    pascal.add_argument("--val-split", default="test")
+    pascal.add_argument("--skip-difficult", action="store_true",
+                        help="drop difficult objects entirely (default: "
+                             "keep as ignore regions)")
     synth = sub.add_parser(
         "synthetic", help="generated dataset (air-gapped dev/CI path)",
         allow_abbrev=False,
@@ -104,7 +115,7 @@ def build_parser() -> argparse.ArgumentParser:
     synth.add_argument("--synthetic-classes", type=int, default=3)
     synth.add_argument("--synthetic-size", type=int, default=256)
 
-    for sp in (coco, csvp, synth):
+    for sp in (coco, csvp, pascal, synth):
         # Also accepted after the subcommand; SUPPRESS so the subparser
         # doesn't clobber a top-level --preset with its default.
         sp.add_argument("--preset", choices=sorted(PRESETS),
@@ -229,6 +240,19 @@ def make_datasets(args):
                 args.val_csv_annotations, args.csv_classes,
                 image_dir=args.image_dir, keep_empty=True,
             )
+        return train, val
+
+    if args.dataset_type == "pascal":
+        from batchai_retinanet_horovod_coco_tpu.data import PascalVocDataset
+
+        train = PascalVocDataset(
+            args.pascal_path, split=args.train_split,
+            skip_difficult=args.skip_difficult,
+        )
+        val = PascalVocDataset(
+            args.pascal_path, split=args.val_split,
+            skip_difficult=args.skip_difficult, keep_empty=True,
+        )
         return train, val
 
     if args.dataset_type == "synthetic":
@@ -432,9 +456,10 @@ def main(argv=None) -> dict[str, float]:
         )
         return run_coco_eval(
             eval_state, model, val_ds, val_batches, detect_config, mesh=mesh,
-            # CSV datasets additionally report the reference's Evaluate-
-            # callback metric (VOC AP@0.5 per class) from the same pass.
-            voc_metrics=args.dataset_type == "csv",
+            # CSV/Pascal datasets additionally report the reference's
+            # Evaluate-callback metric (VOC AP@0.5 per class) from the same
+            # detection pass.
+            voc_metrics=args.dataset_type in ("csv", "pascal"),
         )
 
     logger = MetricLogger(args.log_dir, tensorboard=args.tensorboard)
@@ -482,7 +507,7 @@ def main(argv=None) -> dict[str, float]:
         schedule=schedule,
         shard_weight_update=shard_update,
         eval_fn=eval_fn
-        if (args.eval_every or args.dataset_type == "coco"
+        if (args.eval_every or args.dataset_type in ("coco", "pascal")
             or (args.dataset_type == "csv" and val_ds is not None))
         else None,
         logger=logger,

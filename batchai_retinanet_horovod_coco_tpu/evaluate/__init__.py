@@ -22,6 +22,7 @@ from batchai_retinanet_horovod_coco_tpu.evaluate.detect import (
     coco_gt_from_dataset,
     detections_to_coco,
     make_detect_fn,
+    make_detect_fn_spatial,
     run_coco_eval,
 )
 
@@ -36,5 +37,6 @@ __all__ = [
     "detections_to_coco",
     "evaluate_detections",
     "make_detect_fn",
+    "make_detect_fn_spatial",
     "run_coco_eval",
 ]

@@ -51,17 +51,12 @@ class DetectConfig:
     anchor: anchors_lib.AnchorConfig = anchors_lib.AnchorConfig()
 
 
-def make_detect_fn(
-    model,
-    image_hw: tuple[int, int],
-    config: DetectConfig = DetectConfig(),
-    mesh: Mesh | None = None,
+def _detect_body(
+    model, image_hw: tuple[int, int], config: DetectConfig
 ) -> Callable[[Any, jnp.ndarray], nms_lib.Detections]:
-    """Jitted (state, images (B,H,W,3)) → batched Detections for one bucket.
-
-    With ``mesh``, the batch shards over the ``data`` axis and results gather
-    back — eval uses every chip instead of the reference's rank-0-only path.
-    """
+    """The ONE detection pipeline every factory wraps: normalize → forward →
+    sigmoid → decode → clip → batched NMS.  Shared so the batch-sharded and
+    spatially-sharded paths can never drift from the single-device one."""
     anchors = jnp.asarray(
         anchors_lib.anchors_for_image_shape(image_hw, config.anchor)
     )
@@ -84,6 +79,22 @@ def make_detect_fn(
             max_detections=config.max_detections,
         )
 
+    return detect
+
+
+def make_detect_fn(
+    model,
+    image_hw: tuple[int, int],
+    config: DetectConfig = DetectConfig(),
+    mesh: Mesh | None = None,
+) -> Callable[[Any, jnp.ndarray], nms_lib.Detections]:
+    """Jitted (state, images (B,H,W,3)) → batched Detections for one bucket.
+
+    With ``mesh``, the batch shards over the ``data`` axis and results gather
+    back — eval uses every chip instead of the reference's rank-0-only path.
+    """
+    detect = _detect_body(model, image_hw, config)
+
     if mesh is None:
         return jax.jit(detect)
 
@@ -95,6 +106,41 @@ def make_detect_fn(
         check_vma=False,
     )
     return jax.jit(sharded)
+
+
+def make_detect_fn_spatial(
+    model,
+    image_hw: tuple[int, int],
+    config: DetectConfig = DetectConfig(),
+    mesh: Mesh | None = None,
+) -> Callable[[Any, jnp.ndarray], nms_lib.Detections]:
+    """Detection with the IMAGE sharded across chips (spatial partitioning).
+
+    The long-axis analogue of sequence/context parallelism for a CNN
+    detector (SURVEY.md §2.4/§5.7): instead of sharding the batch, the
+    image's H axis is sharded over the mesh and XLA GSPMD inserts halo
+    exchanges for every conv — ring-attention's "pass the boundary"
+    communication pattern, compiled automatically.  Useful when a single
+    very large image (or tiny batch) must use many chips; per-image latency
+    drops instead of throughput rising.
+
+    Built with ``jit`` + sharding constraints rather than ``shard_map``:
+    spatial conv partitioning needs the compiler's halo machinery, which
+    manual per-device code would have to hand-roll.  Outputs are gathered
+    (the anchor-major reshape reshards after the conv-heavy stage; NMS runs
+    replicated, it is negligible next to the backbone).
+    """
+    from jax.sharding import NamedSharding
+
+    if mesh is None:
+        raise ValueError("spatial detection needs a mesh")
+    rep = NamedSharding(mesh, P())
+    img_sharding = NamedSharding(mesh, P(None, DATA_AXIS))  # shard H
+    return jax.jit(
+        _detect_body(model, image_hw, config),
+        in_shardings=(rep, img_sharding),
+        out_shardings=rep,
+    )
 
 
 def detections_to_coco(

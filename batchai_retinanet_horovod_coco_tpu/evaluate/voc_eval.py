@@ -22,8 +22,10 @@ Semantics mirrored from the reference implementation:
   (0.0, 0) and likewise excludes them from its mean);
 - ``weighted_average`` weights the mean by per-class annotation counts
   (the callback's ``weighted_average`` flag);
-- crowd ground truth (iscrowd=1) is skipped entirely — the VOC metric has no
-  ignore concept and the reference's CSV path never produces crowds.
+- ``iscrowd=1`` ground truth is an IGNORE region (VOC's difficult-box
+  semantics — the Pascal source routes difficult objects here,
+  data/pascal_voc.py): it never counts as an annotation, and a detection
+  whose only qualifying match is an ignore box is neither TP nor FP.
 """
 
 from __future__ import annotations
@@ -74,18 +76,23 @@ def evaluate_detections_voc(
     "voc_AP_<cat>": float per class with annotations}``.
     """
     gt_by_class: dict[int, dict[int, np.ndarray]] = {}
+    ignore_by_class: dict[int, dict[int, np.ndarray]] = {}
     counts: dict[int, int] = {}
     for ann in gt:
-        if ann.get("iscrowd", 0):
-            continue
         cat, img = int(ann["category_id"]), int(ann["image_id"])
+        if ann.get("iscrowd", 0):
+            ignore_by_class.setdefault(cat, {}).setdefault(img, []).append(
+                _to_corners(ann["bbox"])
+            )
+            continue
         gt_by_class.setdefault(cat, {}).setdefault(img, []).append(
             _to_corners(ann["bbox"])
         )
         counts[cat] = counts.get(cat, 0) + 1
-    for per_img in gt_by_class.values():
-        for img, boxes in per_img.items():
-            per_img[img] = np.asarray(boxes, dtype=np.float64)
+    for table in (gt_by_class, ignore_by_class):
+        for per_img in table.values():
+            for img, boxes in per_img.items():
+                per_img[img] = np.asarray(boxes, dtype=np.float64)
 
     dt_by_class: dict[int, list[dict]] = {}
     for det in dt:
@@ -99,21 +106,32 @@ def evaluate_detections_voc(
         tp = np.zeros(len(dets))
         fp = np.zeros(len(dets))
         claimed: dict[int, np.ndarray] = {}
+        cat_ignore = ignore_by_class.get(cat, {})
         for i, det in enumerate(dets):
             img = int(det["image_id"])
+            dbox = np.asarray([_to_corners(det["bbox"])], dtype=np.float64)
+
+            def hits_ignore() -> bool:
+                ign = cat_ignore.get(img)
+                if ign is None or len(ign) == 0:
+                    return False
+                return bool(_iou_matrix(dbox, ign).max() >= iou_threshold)
+
             boxes = gt_by_class[cat].get(img)
             if boxes is None or len(boxes) == 0:
-                fp[i] = 1
+                # Neither TP nor FP when it sits on an ignore region
+                # (tp=fp=0 leaves both cumsums — hence precision/recall at
+                # every other rank — unchanged, equivalent to removal).
+                if not hits_ignore():
+                    fp[i] = 1
                 continue
-            ious = _iou_matrix(
-                np.asarray([_to_corners(det["bbox"])], dtype=np.float64), boxes
-            )[0]
+            ious = _iou_matrix(dbox, boxes)[0]
             j = int(np.argmax(ious))
             taken = claimed.setdefault(img, np.zeros(len(boxes), bool))
             if ious[j] >= iou_threshold and not taken[j]:
                 taken[j] = True
                 tp[i] = 1
-            else:
+            elif not hits_ignore():
                 fp[i] = 1
         ctp, cfp = np.cumsum(tp), np.cumsum(fp)
         recall = ctp / num_ann

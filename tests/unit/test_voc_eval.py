@@ -119,6 +119,25 @@ class TestEvaluateVoc:
         # The crowd gt neither counts as an annotation nor absorbs matches.
         assert evaluate_detections_voc(gts, dts)["voc_mAP"] == pytest.approx(1.0)
 
+    def test_detection_on_ignore_region_is_not_fp(self):
+        """VOC difficult semantics: a hit on an ignore box is neither TP
+        nor FP (data/pascal_voc.py routes difficult objects here)."""
+        gts = [
+            gt_ann(0, 0, (0, 0, 10, 10)),
+            gt_ann(0, 0, (50, 50, 60, 60), iscrowd=1),  # difficult/ignore
+        ]
+        dts = [
+            det(0, 0, (50, 50, 60, 60), 0.95),  # on the ignore region
+            det(0, 0, (0, 0, 10, 10), 0.9),     # TP on the real gt
+        ]
+        # The ignore hit must not deflate precision: AP stays 1.0.
+        assert evaluate_detections_voc(gts, dts)["voc_mAP"] == pytest.approx(1.0)
+        # A genuine miss elsewhere in the image is still an FP.
+        dts_fp = [det(0, 0, (80, 80, 90, 90), 0.95),
+                  det(0, 0, (0, 0, 10, 10), 0.9)]
+        out = evaluate_detections_voc(gts, dts_fp)
+        assert out["voc_mAP"] == pytest.approx(0.5)
+
     def test_no_gt_at_all(self):
         assert evaluate_detections_voc([], [det(0, 0, (0, 0, 5, 5), 0.5)])[
             "voc_mAP"

@@ -245,9 +245,11 @@ def make_datasets(args):
     if args.dataset_type == "pascal":
         from batchai_retinanet_horovod_coco_tpu.data import PascalVocDataset
 
+        # keep_empty: the reference PascalVocGenerator keeps every id in the
+        # split file, background-only and difficult-only images included.
         train = PascalVocDataset(
             args.pascal_path, split=args.train_split,
-            skip_difficult=args.skip_difficult,
+            skip_difficult=args.skip_difficult, keep_empty=True,
         )
         val = PascalVocDataset(
             args.pascal_path, split=args.val_split,

@@ -25,7 +25,9 @@ Semantics mirrored from the reference implementation:
 - ``iscrowd=1`` ground truth is an IGNORE region (VOC's difficult-box
   semantics — the Pascal source routes difficult objects here,
   data/pascal_voc.py): it never counts as an annotation, and a detection
-  whose only qualifying match is an ignore box is neither TP nor FP.
+  whose MAX-overlap match (devkit assignment rule, all boxes considered)
+  is an ignore box at ≥ threshold is neither TP nor FP; duplicates of a
+  claimed real box stay FP even when an ignore box also overlaps.
 """
 
 from __future__ import annotations
@@ -110,29 +112,35 @@ def evaluate_detections_voc(
         for i, det in enumerate(dets):
             img = int(det["image_id"])
             dbox = np.asarray([_to_corners(det["bbox"])], dtype=np.float64)
-
-            def hits_ignore() -> bool:
-                ign = cat_ignore.get(img)
-                if ign is None or len(ign) == 0:
-                    return False
-                return bool(_iou_matrix(dbox, ign).max() >= iou_threshold)
-
             boxes = gt_by_class[cat].get(img)
-            if boxes is None or len(boxes) == 0:
-                # Neither TP nor FP when it sits on an ignore region
-                # (tp=fp=0 leaves both cumsums — hence precision/recall at
-                # every other rank — unchanged, equivalent to removal).
-                if not hits_ignore():
-                    fp[i] = 1
+            n_real = 0 if boxes is None else len(boxes)
+            real_ious = (
+                _iou_matrix(dbox, boxes)[0] if n_real else np.zeros(0)
+            )
+            ign = cat_ignore.get(img)
+            ign_max = (
+                float(_iou_matrix(dbox, ign).max())
+                if ign is not None and len(ign)
+                else -1.0
+            )
+            # VOC devkit rule: assign to the max-overlap gt over ALL boxes,
+            # difficult included.  Winner difficult (≥ threshold) → neither
+            # TP nor FP (tp=fp=0 leaves both cumsums — hence precision and
+            # recall at every other rank — unchanged, equivalent to
+            # removal).  Winner real → TP if unclaimed, else FP (a
+            # duplicate of a claimed box is an FP even if a difficult box
+            # also overlaps it, because the real box overlaps MORE).
+            j = int(np.argmax(real_ious)) if n_real else -1
+            best_real = float(real_ious[j]) if n_real else -1.0
+            if ign_max >= iou_threshold and ign_max > best_real:
                 continue
-            ious = _iou_matrix(dbox, boxes)[0]
-            j = int(np.argmax(ious))
-            taken = claimed.setdefault(img, np.zeros(len(boxes), bool))
-            if ious[j] >= iou_threshold and not taken[j]:
-                taken[j] = True
-                tp[i] = 1
-            elif not hits_ignore():
-                fp[i] = 1
+            if best_real >= iou_threshold:
+                taken = claimed.setdefault(img, np.zeros(n_real, bool))
+                if not taken[j]:
+                    taken[j] = True
+                    tp[i] = 1
+                    continue
+            fp[i] = 1
         ctp, cfp = np.cumsum(tp), np.cumsum(fp)
         recall = ctp / num_ann
         precision = ctp / np.maximum(ctp + cfp, 1e-12)

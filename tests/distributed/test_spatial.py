@@ -50,4 +50,6 @@ def test_spatial_non_divisible_height(tiny_model_and_state):
     a = jax.device_get(plain(state, images))
     b = jax.device_get(spatial(state, images))
     np.testing.assert_array_equal(a.valid, b.valid)
+    np.testing.assert_array_equal(a.labels, b.labels)
     np.testing.assert_allclose(a.scores, b.scores, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(a.boxes, b.boxes, rtol=1e-4, atol=1e-3)

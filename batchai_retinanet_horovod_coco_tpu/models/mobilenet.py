@@ -1,0 +1,99 @@
+"""MobileNetV1 backbone exposing C3, C4, C5 (strides 8/16/32).
+
+Parity target: keras-retinanet's mobilenet backbone family
+(``keras_retinanet/models/mobilenet.py`` — the library supported
+mobilenet128/160/192/224 at several width multipliers as RetinaNet
+backbones, SURVEY.md M2's sibling models).  Rebuilt in flax with the same
+13-block depthwise-separable topology; ``alpha`` is the width multiplier.
+
+TPU note: depthwise convs don't use the MXU (one MAC per channel — they
+lower to VPU ops), so MobileNet trades MXU-friendly FLOPs for bandwidth;
+it is the small/edge option, not the fast-TPU option.  NHWC, bf16
+activations / f32 params, same norm factory as ResNet.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from batchai_retinanet_horovod_coco_tpu.models.resnet import NormFactory
+
+
+class _DepthwiseSeparable(nn.Module):
+    """3x3 depthwise (+stride) → BN/GN → relu6 → 1x1 pointwise → norm → relu6."""
+
+    filters: int
+    stride: int
+    norm: NormFactory
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        in_ch = x.shape[-1]
+        x = nn.Conv(
+            in_ch,
+            (3, 3),
+            strides=(self.stride, self.stride),
+            padding="SAME",
+            feature_group_count=in_ch,  # depthwise
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name="dw",
+        )(x)
+        x = self.norm("dw_norm", train)(x)
+        x = nn.relu6(x)
+        x = nn.Conv(
+            self.filters,
+            (1, 1),
+            use_bias=False,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            name="pw",
+        )(x)
+        x = self.norm("pw_norm", train)(x)
+        return nn.relu6(x)
+
+
+class MobileNetV1(nn.Module):
+    """The 13-block MobileNetV1 body; returns {"c3", "c4", "c5"}."""
+
+    alpha: float = 1.0
+    norm_kind: str = "gn"
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> dict[str, jnp.ndarray]:
+        norm = NormFactory(self.norm_kind, self.dtype)
+
+        def width(ch: int) -> int:
+            scaled = int(ch * self.alpha)
+            # GroupNorm(32) needs channel counts divisible by 32.
+            return max(32, (scaled // 32) * 32)
+
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            width(32), (3, 3), strides=(2, 2), padding="SAME", use_bias=False,
+            dtype=self.dtype, param_dtype=jnp.float32, name="stem",
+        )(x)
+        x = norm("stem_norm", train)(x)
+        x = nn.relu6(x)
+
+        # (filters, stride) for the 13 depthwise-separable blocks; C3/C4/C5
+        # are the last outputs at strides 8/16/32.
+        blocks = [
+            (64, 1), (128, 2), (128, 1), (256, 2), (256, 1),
+            (512, 2), (512, 1), (512, 1), (512, 1), (512, 1), (512, 1),
+            (1024, 2), (1024, 1),
+        ]
+        features: dict[str, jnp.ndarray] = {}
+        taps = {5: "c3", 11: "c4", 13: "c5"}  # 1-based block index
+        for i, (filters, stride) in enumerate(blocks, 1):
+            x = _DepthwiseSeparable(
+                filters=width(filters), stride=stride, norm=norm,
+                dtype=self.dtype, name=f"block{i}",
+            )(x, train=train)
+            if i in taps:
+                features[taps[i]] = x
+        return features

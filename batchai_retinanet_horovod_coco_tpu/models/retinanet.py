@@ -59,6 +59,51 @@ _BACKBONE_STAGES = {
     "resnet_test": (1, 1, 1, 1),
 }
 
+BACKBONES = tuple(
+    k for k, v in _BACKBONE_STAGES.items() if v is not None
+) + ("mobilenet", "mobilenet050", "vgg16", "vgg19")
+
+
+def build_backbone(cfg: "RetinaNetConfig"):
+    """Backbone registry: every entry returns a module producing
+    {"c3", "c4", "c5"} at strides 8/16/32 (the FPN input contract).
+
+    The reference library's backbone families (SURVEY.md M2: ResNet primary;
+    mobilenet/vgg siblings in keras_retinanet/models/).  ``norm_kind`` and
+    ``stem`` apply where the architecture has them (VGG has no norm layers;
+    only ResNet has the 7x7/2 stem the space_to_depth mode reformulates).
+    """
+    name = cfg.backbone
+    stages = _BACKBONE_STAGES.get(name)
+    if stages is not None:
+        return ResNet(
+            stage_sizes=stages,
+            norm_kind=cfg.norm_kind,
+            dtype=cfg.dtype,
+            stem=cfg.stem,
+            name="backbone",
+        )
+    if name in ("mobilenet", "mobilenet050"):
+        from batchai_retinanet_horovod_coco_tpu.models.mobilenet import (
+            MobileNetV1,
+        )
+
+        return MobileNetV1(
+            alpha=0.5 if name == "mobilenet050" else 1.0,
+            norm_kind=cfg.norm_kind,
+            dtype=cfg.dtype,
+            name="backbone",
+        )
+    if name in ("vgg16", "vgg19"):
+        from batchai_retinanet_horovod_coco_tpu.models.vgg import VGG
+
+        return VGG(
+            stage_sizes=(2, 2, 3, 3, 3) if name == "vgg16" else (2, 2, 4, 4, 4),
+            dtype=cfg.dtype,
+            name="backbone",
+        )
+    raise ValueError(f"unsupported backbone: {name!r}")
+
 
 class RetinaNet(nn.Module):
     config: RetinaNetConfig
@@ -80,18 +125,9 @@ class RetinaNet(nn.Module):
         function's docstring — so the step does not use it).
         """
         cfg = self.config
-        stages = _BACKBONE_STAGES.get(cfg.backbone)
-        if stages is None:
-            raise ValueError(f"unsupported backbone: {cfg.backbone!r}")
         # named_scope: phase labels in profiler traces (SURVEY.md §5.1).
         with jax.named_scope("backbone"):
-            features = ResNet(
-                stage_sizes=stages,
-                norm_kind=cfg.norm_kind,
-                dtype=cfg.dtype,
-                stem=cfg.stem,
-                name="backbone",
-            )(images, train=train)
+            features = build_backbone(cfg)(images, train=train)
         with jax.named_scope("fpn"):
             pyramid = FPN(
                 channels=cfg.fpn_channels, dtype=cfg.dtype, name="fpn"

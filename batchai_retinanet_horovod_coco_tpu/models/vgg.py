@@ -1,0 +1,52 @@
+"""VGG-16/19 backbone exposing C3, C4, C5 (strides 8/16/32).
+
+Parity target: keras-retinanet's vgg backbone
+(``keras_retinanet/models/vgg.py`` — uses block3_pool/block4_pool/
+block5_pool as the FPN inputs, SURVEY.md M2's sibling models).  Classic VGG
+has no normalization layers; the flax rebuild keeps that (``norm_kind`` is
+accepted for interface uniformity and ignored), so ``--f32`` or bf16 both
+work without mutable state.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class VGG(nn.Module):
+    """VGG body; returns {"c3", "c4", "c5"} = pooled blocks 3/4/5."""
+
+    stage_sizes: Sequence[int]  # convs per block, e.g. (2, 2, 3, 3, 3)
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> dict[str, jnp.ndarray]:
+        del train  # no norm/dropout state in the detection backbone
+        widths = (64, 128, 256, 512, 512)
+        x = x.astype(self.dtype)
+        features: dict[str, jnp.ndarray] = {}
+        for block, (n_convs, width) in enumerate(
+            zip(self.stage_sizes, widths), 1
+        ):
+            for i in range(n_convs):
+                x = nn.Conv(
+                    width, (3, 3), padding="SAME",
+                    dtype=self.dtype, param_dtype=jnp.float32,
+                    name=f"block{block}_conv{i + 1}",
+                )(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            if block >= 3:  # pool3 /8, pool4 /16, pool5 /32
+                features[f"c{block}"] = x
+        return features
+
+
+def vgg16(dtype: jnp.dtype = jnp.bfloat16) -> VGG:
+    return VGG(stage_sizes=(2, 2, 3, 3, 3), dtype=dtype)
+
+
+def vgg19(dtype: jnp.dtype = jnp.bfloat16) -> VGG:
+    return VGG(stage_sizes=(2, 2, 4, 4, 4), dtype=dtype)

@@ -31,8 +31,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="orbax checkpoint directory (train.py --snapshot-path)")
     p.add_argument("--output", required=True, help="export directory")
     p.add_argument("--num-classes", type=int, required=True)
-    p.add_argument("--backbone", default="resnet50",
-                   choices=["resnet50", "resnet101", "resnet152", "resnet_test"])
+    from batchai_retinanet_horovod_coco_tpu.models.retinanet import BACKBONES
+
+    p.add_argument("--backbone", default="resnet50", choices=BACKBONES)
     p.add_argument("--norm", default="gn", choices=["gn", "bn", "frozen_bn"])
     p.add_argument("--stem", default="space_to_depth",
                    choices=["conv", "space_to_depth"],

@@ -59,6 +59,9 @@ PRESETS: dict[str, dict] = {
 from batchai_retinanet_horovod_coco_tpu.data.pipeline import (  # noqa: E402
     default_buckets,
 )
+from batchai_retinanet_horovod_coco_tpu.models.retinanet import (  # noqa: E402
+    BACKBONES,
+)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -121,8 +124,7 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--preset", choices=sorted(PRESETS),
                         default=argparse.SUPPRESS, help=argparse.SUPPRESS)
         g = sp.add_argument_group("model")
-        g.add_argument("--backbone", default="resnet50",
-                       choices=["resnet50", "resnet101", "resnet152", "resnet_test"])
+        g.add_argument("--backbone", default="resnet50", choices=BACKBONES)
         g.add_argument("--norm", default="gn", choices=["gn", "bn", "frozen_bn"])
         g.add_argument("--stem", default="space_to_depth",
                        choices=["conv", "space_to_depth"],

@@ -266,17 +266,23 @@ def run_coco_eval(
     config: DetectConfig = DetectConfig(),
     mesh: Mesh | None = None,
     voc_metrics: bool = False,
+    voc_weighted_average: bool = False,
 ) -> dict[str, float]:
     """Full eval pass: detect everything, then mAP via the numpy oracle.
 
     With ``voc_metrics``, the same detection pass additionally yields
     PASCAL-VOC AP@0.5 per class (the reference's ``Evaluate`` callback
     metric for CSV/custom datasets, evaluate/voc_eval.py), merged into the
-    returned dict under ``voc_*`` keys.
+    returned dict under ``voc_*`` keys; ``voc_weighted_average`` weights
+    the VOC mean by per-class annotation counts (the callback's flag).
     """
     dt = collect_detections(state, model, dataset, batches, config, mesh=mesh)
     gt, img_ids = coco_gt_from_dataset(dataset)
     metrics = evaluate_detections(gt, dt, img_ids=img_ids)
     if voc_metrics:
-        metrics.update(evaluate_detections_voc(gt, dt))
+        metrics.update(
+            evaluate_detections_voc(
+                gt, dt, weighted_average=voc_weighted_average
+            )
+        )
     return metrics

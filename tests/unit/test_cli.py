@@ -45,6 +45,17 @@ class TestParseArgs:
         assert args.coco_path == "/data/coco"
         assert args.train_annotations.endswith("instances_train2017.json")
 
+    def test_pascal_paths(self):
+        args = parse_args(
+            ["pascal", "/data/VOC2007", "--train-split", "train",
+             "--weighted-average"]
+        )
+        assert args.pascal_path == "/data/VOC2007"
+        assert args.train_split == "train"
+        assert args.val_split == "test"
+        assert args.weighted_average is True
+        assert args.skip_difficult is False
+
     def test_csv_paths(self):
         args = parse_args(
             ["csv", "/data/ann.csv", "/data/classes.csv",

@@ -186,6 +186,9 @@ def build_parser() -> argparse.ArgumentParser:
         g.add_argument("--score-threshold", type=float, default=0.05)
         g.add_argument("--nms-threshold", type=float, default=0.5)
         g.add_argument("--max-detections", type=int, default=300)
+        g.add_argument("--weighted-average", action="store_true",
+                       help="weight the VOC mAP by per-class annotation "
+                            "counts (reference Evaluate flag; csv/pascal)")
 
         g = sp.add_argument_group("distributed")
         g.add_argument("--num-devices", type=int, default=1,
@@ -464,6 +467,7 @@ def main(argv=None) -> dict[str, float]:
             # Evaluate-callback metric (VOC AP@0.5 per class) from the same
             # detection pass.
             voc_metrics=args.dataset_type in ("csv", "pascal"),
+            voc_weighted_average=args.weighted_average,
         )
 
     logger = MetricLogger(args.log_dir, tensorboard=args.tensorboard)

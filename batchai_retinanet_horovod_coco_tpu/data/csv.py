@@ -36,6 +36,10 @@ _EMPTY1F = np.zeros((0,), dtype=np.float32)
 
 
 def _parse_num(value: str, what: str, line: int) -> float:
+    # Python numeric literals allow digit-group underscores ('1_0' == 10);
+    # a CSV containing one is a typo, not a number — reject it.
+    if "_" in value:
+        raise ValueError(f"line {line}: malformed {what}: {value!r}")
     try:
         parsed = float(value)
     except ValueError:
@@ -46,17 +50,22 @@ def _parse_num(value: str, what: str, line: int) -> float:
 
 
 def _parse_int(value: str, what: str, line: int) -> int:
-    try:
-        return int(value)
-    except ValueError:
-        raise ValueError(f"line {line}: malformed {what}: {value!r}") from None
+    # isdecimal, not isdigit: digit-but-not-decimal characters ('²') pass
+    # isdigit but are rejected by int().
+    if not value.strip().isdecimal():
+        raise ValueError(f"line {line}: malformed {what}: {value!r}")
+    return int(value)
 
 
 def read_classes(path: str) -> dict[str, int]:
     """Parse classes.csv → {name: id}; ids must be exactly 0..K-1."""
     mapping: dict[str, int] = {}
     with open(path, newline="") as f:
-        for line, row in enumerate(_csv.reader(f), 1):
+        reader = _csv.reader(f)
+        for row in reader:
+            # reader.line_num is the physical file line, correct even when a
+            # quoted field spans multiple lines (record index would drift).
+            line = reader.line_num
             if not row:
                 continue
             if len(row) != 2:
@@ -105,7 +114,9 @@ class CsvDataset:
         per_image: dict[str, list[tuple[np.ndarray, int]]] = {}
         order: list[str] = []
         with open(annotation_file, newline="") as f:
-            for line, row in enumerate(_csv.reader(f), 1):
+            reader = _csv.reader(f)
+            for row in reader:
+                line = reader.line_num  # physical line, not record index
                 if not row:
                     continue
                 if len(row) != 6:

@@ -209,14 +209,21 @@ def make_train_step(
                 zero_step, donate_argnums=(0,) if donate_state else ()
             )
 
-        # The spec tree depends on the opt_state structure, which only the
-        # caller's state knows — build lazily on first call and cache.
-        cache: dict[str, Callable] = {}
+        # The spec tree depends on the state's tree structure, which only the
+        # caller's state knows — build lazily per structure and cache, keyed
+        # on the treedefs so a structurally different state (e.g. a swapped
+        # optimizer) gets fresh partition specs instead of stale ones.
+        cache: dict[Any, Callable] = {}
 
         def zero_entry(state: TrainState, batch: dict[str, Any]):
-            if "fn" not in cache:
-                cache["fn"] = make_zero_step(state)
-            return cache["fn"](state, batch)
+            key = (
+                jax.tree.structure(state.opt_state),
+                jax.tree.structure(state.params),
+                jax.tree.structure(state.batch_stats),
+            )
+            if key not in cache:
+                cache[key] = make_zero_step(state)
+            return cache[key](state, batch)
 
         return zero_entry
 

@@ -23,13 +23,13 @@ def main(argv: list[str] | None = None):
             print(f"{k}: {metrics[k]:.4f}")
     # VOC metrics (CSV datasets): voc_mAP first, then per-class APs in
     # numeric class-id order (string sort would put voc_AP_10 before voc_AP_2).
+    # Only voc_* keys enter the sort: COCO keys like 'AP' have no '_' tail.
     def voc_order(k: str):
-        tail = k.rsplit("_", 1)[1]
+        tail = k.rsplit("_", 1)[-1]
         return (k != "voc_mAP", int(tail) if tail.isdigit() else 0, k)
 
-    for k in sorted(metrics, key=voc_order):
-        if k.startswith("voc_"):
-            print(f"{k}: {metrics[k]:.4f}")
+    for k in sorted((k for k in metrics if k.startswith("voc_")), key=voc_order):
+        print(f"{k}: {metrics[k]:.4f}")
     return metrics
 
 

@@ -32,3 +32,44 @@ class TestDebugCli:
         )
         vis = list((tmp_path / "vis").glob("*.jpg"))
         assert len(vis) == 3
+
+
+class TestEvaluateCli:
+    """evaluate.main's metric formatting — fast, no model in the loop."""
+
+    def _run(self, monkeypatch, capsys, metrics):
+        import evaluate
+        import train
+
+        seen_argv = {}
+
+        def fake_train_main(argv):
+            seen_argv["argv"] = argv
+            return metrics
+
+        monkeypatch.setattr(train, "main", fake_train_main)
+        out = evaluate.main(["synthetic"])
+        assert out is metrics
+        assert seen_argv["argv"][-1] == "--eval-only"
+        return capsys.readouterr().out.strip().splitlines()
+
+    def test_coco_metrics_print_without_voc_keys(self, monkeypatch, capsys):
+        # Regression: COCO keys ('AP') used to hit the voc sort key's
+        # rsplit('_')[1] and raise IndexError on every run.
+        lines = self._run(
+            monkeypatch, capsys, {"AP": 0.5, "AP50": 0.7, "loss": 1.0}
+        )
+        assert lines == ["AP: 0.5000", "AP50: 0.7000"]
+
+    def test_voc_metrics_numeric_order(self, monkeypatch, capsys):
+        lines = self._run(
+            monkeypatch,
+            capsys,
+            {"AP": 0.5, "voc_AP_10": 0.2, "voc_AP_2": 0.1, "voc_mAP": 0.6},
+        )
+        assert lines == [
+            "AP: 0.5000",
+            "voc_mAP: 0.6000",
+            "voc_AP_2: 0.1000",
+            "voc_AP_10: 0.2000",
+        ]

@@ -123,3 +123,27 @@ def test_pipeline_compatibility(csv_root):
     assert batch.gt_boxes.shape == (2, 10, 4)
     # a.jpg (64x48) scales by min(64/48 rule, fit) — boxes scale with it.
     assert batch.gt_mask[0].sum() == 2
+
+
+def test_underscore_literals_rejected(csv_root, tmp_path):
+    # Python allows digit-group underscores ('1_0' == 10); a CSV containing
+    # one is a typo and must be rejected, for class ids and coordinates both.
+    bad = tmp_path / "classes.csv"
+    bad.write_text("cat,1_0\n")
+    with pytest.raises(ValueError, match="malformed class id"):
+        read_classes(str(bad))
+    ann = tmp_path / "bad.csv"
+    ann.write_text("a.jpg,1_0,2,30,40,cat\n")
+    with pytest.raises(ValueError, match="malformed x1"):
+        CsvDataset(str(ann), str(csv_root / "classes.csv"),
+                   image_dir=str(csv_root))
+
+
+def test_error_reports_physical_line_number(csv_root, tmp_path):
+    # A quoted field spanning two physical lines: the error on the NEXT
+    # record must cite the physical file line (3), not the record index (2).
+    ann = tmp_path / "multiline.csv"
+    ann.write_text('"a\nb.jpg",1,2,30,40,cat\nc.jpg,x,2,30,40,cat\n')
+    with pytest.raises(ValueError, match="line 3"):
+        CsvDataset(str(ann), str(csv_root / "classes.csv"),
+                   image_dir=str(csv_root))

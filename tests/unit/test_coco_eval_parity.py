@@ -1,0 +1,468 @@
+"""External validation of the COCO mAP oracle (SURVEY.md §7.3 hard part 4).
+
+evaluate/coco_eval.py and native/cocoeval.cpp are validated against each
+other elsewhere (tests/unit/test_coco_eval.py, test_native_cocoeval.py), but
+both share one author and one reading of the COCOeval contract.  This module
+breaks that circularity two ways:
+
+1. **Analytic fixtures** — scenes small enough that the 101-point-interpolated
+   AP is derived by hand (exact fractions in the comments), covering the
+   contract's edges: score ties under stable sort, crowd rematch, gt and det
+   area-range boundaries (exactly 32² and 96²), maxDets truncation, images
+   with no gt (pure false positives), duplicate detections on one gt, and a
+   recall landing exactly on a sampled threshold (the searchsorted
+   side="left" edge — side="right" shifts AP from 51/101 to 50/101 and every
+   test in TestInterpolationEdge fails).
+
+2. **A brute-force independent implementation** — pure-Python, per-detection
+   loops, no IoU caching, no vectorized envelope: precision at recall r is
+   literally max(precision at any curve point with recall ≥ r).  Random
+   scenes (ties, crowds, ignores, off-area boxes) must match the package
+   oracle on all 12 stats exactly.
+
+Nothing here imports oracle internals — only the public
+``evaluate_detections`` / ``CocoEval`` surface under test.
+"""
+
+import numpy as np
+import pytest
+
+from batchai_retinanet_horovod_coco_tpu.evaluate.coco_eval import (
+    evaluate_detections,
+)
+
+# ---------------------------------------------------------------------------
+# Independent brute-force COCOeval (bbox), written from the published
+# contract: greedy per-image per-category matching in descending score order;
+# crowd/out-of-range gts matchable but ignored; unmatched detections with
+# out-of-range area ignored; 101-point interpolated AP.
+# ---------------------------------------------------------------------------
+
+IOU_THRS = [0.5 + 0.05 * i for i in range(10)]
+REC_THRS = [i / 100.0 for i in range(101)]
+AREA_RNG = {
+    "all": (0.0, 1e10),
+    "small": (0.0, 32.0**2),
+    "medium": (32.0**2, 96.0**2),
+    "large": (96.0**2, 1e10),
+}
+MAX_DETS = (1, 10, 100)
+
+
+def _iou_xywh(d, g, crowd):
+    dx, dy, dw, dh = d
+    gx, gy, gw, gh = g
+    iw = min(dx + dw, gx + gw) - max(dx, gx)
+    ih = min(dy + dh, gy + gh) - max(dy, gy)
+    if iw <= 0 or ih <= 0:
+        return 0.0
+    inter = iw * ih
+    union = dw * dh if crowd else dw * dh + gw * gh - inter
+    return inter / union if union > 0 else 0.0
+
+
+def _match_image(dts, gts, thr, area_rng):
+    """Greedy matching for one (image, category, IoU threshold, area range).
+
+    dts: score-sorted list of det dicts; gts: list of gt dicts.
+    Returns per-det (matched, ignored) flags and the non-ignored gt count.
+    """
+    lo, hi = area_rng
+    ig = [
+        bool(g.get("ignore", 0))
+        or bool(g.get("iscrowd", 0))
+        or g["area"] < lo
+        or g["area"] > hi
+        for g in gts
+    ]
+    # Non-ignored gts first, stably — the preference order of the greedy scan.
+    order = sorted(range(len(gts)), key=lambda i: ig[i])
+    claimed = [False] * len(gts)
+    out = []
+    for det in dts:
+        floor = min(thr, 1.0 - 1e-10)
+        # Pass 1: the best still-unclaimed NON-ignored gt with IoU ≥ thr;
+        # equal IoU prefers the later gt in preference order (the reference
+        # scan overwrites on ties).
+        pick = -1
+        best = floor
+        for gi in order:
+            if ig[gi] or claimed[gi]:
+                continue
+            iou = _iou_xywh(det["bbox"], gts[gi]["bbox"], False)
+            if iou >= best:
+                best = iou
+                pick = gi
+        if pick < 0:
+            # Pass 2: ignored gts (crowds rematchable even when claimed).
+            best = floor
+            for gi in order:
+                if not ig[gi]:
+                    continue
+                crowd = bool(gts[gi].get("iscrowd", 0))
+                if claimed[gi] and not crowd:
+                    continue
+                iou = _iou_xywh(det["bbox"], gts[gi]["bbox"], crowd)
+                if iou >= best:
+                    best = iou
+                    pick = gi
+        if pick >= 0:
+            claimed[pick] = True
+            out.append((True, ig[pick]))
+        else:
+            w, h = det["bbox"][2], det["bbox"][3]
+            area = w * h
+            out.append((False, area < lo or area > hi))
+    return out, sum(1 for f in ig if not f)
+
+
+def brute_force_stats(gt_anns, dt_anns, img_ids=None):
+    """The 12 COCO stats, computed the slow transparent way."""
+    if img_ids is None:
+        img_ids = sorted(
+            {a["image_id"] for a in gt_anns} | {a["image_id"] for a in dt_anns}
+        )
+    cat_ids = sorted(
+        {a["category_id"] for a in gt_anns} | {a["category_id"] for a in dt_anns}
+    )
+    gts = {
+        (i, c): [a for a in gt_anns if a["image_id"] == i and a["category_id"] == c]
+        for i in img_ids
+        for c in cat_ids
+    }
+    dts = {
+        (i, c): sorted(
+            (a for a in dt_anns if a["image_id"] == i and a["category_id"] == c),
+            key=lambda a: -a["score"],
+        )[: MAX_DETS[-1]]
+        for i in img_ids
+        for c in cat_ids
+    }
+
+    # curves[(area, maxdet)][(thr, cat)] = (ap, final_recall) or None
+    curves = {}
+    for area_lbl, area_rng in AREA_RNG.items():
+        for max_det in MAX_DETS:
+            for cat in cat_ids:
+                imgs = [
+                    i for i in img_ids if gts[(i, cat)] or dts[(i, cat)]
+                ]
+                for thr in IOU_THRS:
+                    entries = []  # (score, pos, matched, ignored)
+                    npig = 0
+                    for pos, img in enumerate(imgs):
+                        flags, n = _match_image(
+                            dts[(img, cat)][:max_det],
+                            gts[(img, cat)],
+                            thr,
+                            area_rng,
+                        )
+                        npig += n
+                        for j, (matched, ignored) in enumerate(flags):
+                            entries.append(
+                                (dts[(img, cat)][j]["score"], pos, j, matched, ignored)
+                            )
+                    if not imgs or npig == 0:
+                        curves[(area_lbl, max_det, thr, cat)] = None
+                        continue
+                    # Global stable sort: descending score, image order, then
+                    # per-image score order as tie-breaks.
+                    entries.sort(key=lambda e: (-e[0], e[1], e[2]))
+                    tp = fp = 0
+                    points = []  # (recall, precision)
+                    for _, _, _, matched, ignored in entries:
+                        if not ignored:
+                            tp += matched
+                            fp += not matched
+                        denom = tp + fp
+                        points.append(
+                            (tp / npig, tp / denom if denom else 0.0)
+                        )
+                    sampled = []
+                    for r in REC_THRS:
+                        qs = [p for rc, p in points if rc >= r]
+                        sampled.append(max(qs) if qs else 0.0)
+                    final_recall = points[-1][0] if points else 0.0
+                    curves[(area_lbl, max_det, thr, cat)] = (
+                        sum(sampled) / len(sampled),
+                        final_recall,
+                    )
+
+    def mean_ap(area, max_det, thrs):
+        vals = [
+            curves[(area, max_det, t, c)][0]
+            for t in thrs
+            for c in cat_ids
+            if curves[(area, max_det, t, c)] is not None
+        ]
+        return sum(vals) / len(vals) if vals else -1.0
+
+    def mean_ar(area, max_det):
+        vals = [
+            curves[(area, max_det, t, c)][1]
+            for t in IOU_THRS
+            for c in cat_ids
+            if curves[(area, max_det, t, c)] is not None
+        ]
+        return sum(vals) / len(vals) if vals else -1.0
+
+    return {
+        "AP": mean_ap("all", 100, IOU_THRS),
+        "AP50": mean_ap("all", 100, [IOU_THRS[0]]),
+        "AP75": mean_ap("all", 100, [IOU_THRS[5]]),
+        "APsmall": mean_ap("small", 100, IOU_THRS),
+        "APmedium": mean_ap("medium", 100, IOU_THRS),
+        "APlarge": mean_ap("large", 100, IOU_THRS),
+        "AR1": mean_ar("all", 1),
+        "AR10": mean_ar("all", 10),
+        "AR100": mean_ar("all", 100),
+        "ARsmall": mean_ar("small", 100),
+        "ARmedium": mean_ar("medium", 100),
+        "ARlarge": mean_ar("large", 100),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Fixture helpers
+# ---------------------------------------------------------------------------
+
+_next_id = [1]
+
+
+def g(img, bbox, cat=1, area=None, iscrowd=0, ignore=0):
+    _next_id[0] += 1
+    return {
+        "id": _next_id[0],
+        "image_id": img,
+        "category_id": cat,
+        "bbox": list(map(float, bbox)),
+        "area": float(bbox[2] * bbox[3] if area is None else area),
+        "iscrowd": iscrowd,
+        "ignore": ignore,
+    }
+
+
+def d(img, bbox, score, cat=1):
+    return {
+        "image_id": img,
+        "category_id": cat,
+        "bbox": list(map(float, bbox)),
+        "score": float(score),
+    }
+
+
+def both(gt, dt, **kw):
+    """Run the package oracle and the brute force; they must agree exactly."""
+    ours = evaluate_detections(gt, dt, **kw)
+    ref = brute_force_stats(gt, dt, **kw)
+    for name, val in ref.items():
+        np.testing.assert_allclose(
+            ours[name], val, atol=1e-12, err_msg=f"stat {name}"
+        )
+    return ours
+
+
+# ---------------------------------------------------------------------------
+# Analytic fixtures (expected values derived by hand in the comments)
+# ---------------------------------------------------------------------------
+
+
+class TestAnalyticFixtures:
+    def test_perfect_detection(self):
+        m = both([g(1, (0, 0, 10, 10))], [d(1, (0, 0, 10, 10), 0.9)])
+        assert m["AP"] == 1.0 and m["AP50"] == 1.0 and m["AR100"] == 1.0
+
+    def test_iou_exactly_at_threshold(self):
+        # IoU(det, gt) = 100/200 = 0.5 exactly: matched at t=0.50 only
+        # (the matcher floor is min(t, 1-1e-10), inclusive), so
+        # AP = (1 + 9*0)/10 = 0.1 and AP50 = 1, AP75 = 0.
+        m = both([g(1, (0, 0, 10, 10))], [d(1, (0, 0, 10, 20), 0.9)])
+        np.testing.assert_allclose(m["AP"], 0.1, atol=1e-12)
+        assert m["AP50"] == 1.0 and m["AP75"] == 0.0
+        np.testing.assert_allclose(m["APsmall"], 0.1, atol=1e-12)
+        assert m["APmedium"] == -1.0  # gt (area 100) out of range → no gt
+
+    def test_score_tie_keeps_insertion_order(self):
+        # FP then TP at the SAME score: the stable sort keeps insertion
+        # order, so the curve is [p=0, r=0], [p=.5, r=1] → envelope 0.5
+        # everywhere → AP = 0.5.  An unstable sort that flips the pair
+        # would give AP = 1.0.
+        gt = [g(1, (0, 0, 10, 10))]
+        dt = [d(1, (50, 50, 10, 10), 0.5), d(1, (0, 0, 10, 10), 0.5)]
+        m = both(gt, dt)
+        np.testing.assert_allclose(m["AP"], 0.5, atol=1e-12)
+        assert m["AR100"] == 1.0
+
+    def test_crowd_rematch_and_ignore(self):
+        # Two dets inside one crowd region (both must match it — crowds are
+        # rematchable — and be ignored), plus one real TP at a LOWER score.
+        # Correct: AP = 1.  Crowd-as-FP would give 1/3; no-rematch (second
+        # crowd det becomes FP) would give 0.5.
+        gt = [g(1, (0, 0, 30, 30), iscrowd=1), g(1, (50, 50, 10, 10))]
+        dt = [
+            d(1, (0, 0, 10, 10), 0.9),
+            d(1, (12, 0, 10, 10), 0.8),
+            d(1, (50, 50, 10, 10), 0.7),
+        ]
+        m = both(gt, dt)
+        assert m["AP"] == 1.0 and m["APsmall"] == 1.0
+
+    def test_explicit_ignore_flag(self):
+        # An ignore-flagged gt is matchable but contributes no npig: the det
+        # on it is neither TP nor FP, and the remaining TP gives AP = 1.
+        gt = [g(1, (0, 0, 10, 10), ignore=1), g(1, (30, 30, 10, 10))]
+        dt = [d(1, (0, 0, 10, 10), 0.9), d(1, (30, 30, 10, 10), 0.8)]
+        m = both(gt, dt)
+        assert m["AP"] == 1.0
+
+    def test_gt_area_boundary_inclusive_both_sides(self):
+        # gt area exactly 32² = 1024 sits in BOTH small [0,1024] and
+        # medium [1024,9216] (the range test is lo ≤ area ≤ hi).
+        m = both([g(1, (0, 0, 32, 32))], [d(1, (0, 0, 32, 32), 0.9)])
+        assert m["APsmall"] == 1.0
+        assert m["APmedium"] == 1.0
+        assert m["APlarge"] == -1.0
+
+    def test_det_area_boundary_counts_as_fp(self):
+        # Unmatched det with area exactly 96² = 9216 is INSIDE the large
+        # range [9216,1e10] → a real FP ahead of the TP → APlarge = 0.5.
+        # If the boundary were exclusive the det would be ignored and
+        # APlarge would be 1.0.
+        gt = [g(1, (0, 0, 150, 150))]
+        dt = [d(1, (300, 300, 96, 96), 0.9), d(1, (0, 0, 150, 150), 0.5)]
+        m = both(gt, dt)
+        np.testing.assert_allclose(m["APlarge"], 0.5, atol=1e-12)
+        np.testing.assert_allclose(m["AP"], 0.5, atol=1e-12)
+        assert m["APmedium"] == -1.0  # gt out of medium range
+
+    def test_max_dets_truncation(self):
+        # 3 gts, 3 perfect dets: AR1 sees only the top-scored det → 1/3;
+        # AR10/AR100 see all → 1.
+        gt = [g(1, (x, 0, 10, 10)) for x in (0, 20, 40)]
+        dt = [
+            d(1, (0, 0, 10, 10), 0.9),
+            d(1, (20, 0, 10, 10), 0.8),
+            d(1, (40, 0, 10, 10), 0.7),
+        ]
+        m = both(gt, dt)
+        np.testing.assert_allclose(m["AR1"], 1 / 3, atol=1e-12)
+        assert m["AR10"] == 1.0 and m["AR100"] == 1.0 and m["AP"] == 1.0
+
+    def test_image_with_no_gt_contributes_fps(self):
+        # The higher-scored det on a gt-less image is a real FP ahead of
+        # the TP → AP = 0.5.  Dropping no-gt images would report 1.0.
+        gt = [g(1, (0, 0, 10, 10))]
+        dt = [d(2, (0, 0, 10, 10), 0.95), d(1, (0, 0, 10, 10), 0.9)]
+        m = both(gt, dt)
+        np.testing.assert_allclose(m["AP"], 0.5, atol=1e-12)
+
+    def test_duplicate_detections_one_gt(self):
+        # d1 TP on A (r=.5, p=1), d2 duplicate on A → FP (r=.5, p=.5),
+        # d3 TP on B (r=1, p=2/3).  Envelope [1, 2/3, 2/3]; sampling gives
+        # 51 points at 1 (r ≤ .5) and 50 at 2/3 → AP = 253/303.
+        gt = [g(1, (0, 0, 10, 10)), g(1, (20, 0, 10, 10))]
+        dt = [
+            d(1, (0, 0, 10, 10), 0.9),
+            d(1, (0, 1, 10, 10), 0.8),
+            d(1, (20, 0, 10, 10), 0.7),
+        ]
+        m = both(gt, dt)
+        np.testing.assert_allclose(m["AP"], 253 / 303, atol=1e-12)
+        np.testing.assert_allclose(m["AR1"], 0.5, atol=1e-12)
+
+
+class TestInterpolationEdge:
+    """Recall landing EXACTLY on a sampled threshold (searchsorted side)."""
+
+    def test_recall_exactly_half(self):
+        # 2 gts, 1 TP: the curve's only point is (r=0.5, p=1).  Recall
+        # threshold 0.50 must sample it (side="left" semantics): 51 of the
+        # 101 points (0.00..0.50) get precision 1 → AP = 51/101.  A
+        # side="right" implementation samples 50 → 50/101.
+        gt = [g(1, (0, 0, 10, 10)), g(1, (30, 30, 10, 10))]
+        dt = [d(1, (0, 0, 10, 10), 0.9)]
+        m = both(gt, dt)
+        np.testing.assert_allclose(m["AP"], 51 / 101, atol=1e-12)
+        np.testing.assert_allclose(m["AR100"], 0.5, atol=1e-12)
+
+    def test_recall_exactly_quarter(self):
+        # 4 gts, 1 TP: point (r=0.25, p=1) → 26 points at 1 → AP = 26/101.
+        gt = [g(1, (x, y, 10, 10)) for x in (0, 30) for y in (0, 30)]
+        dt = [d(1, (0, 0, 10, 10), 0.9)]
+        m = both(gt, dt)
+        np.testing.assert_allclose(m["AP"], 26 / 101, atol=1e-12)
+
+    def test_every_fifth_threshold(self):
+        # 5 gts, 3 TPs with descending scores: points (0.2,1),(0.4,1),(0.6,1)
+        # → r ≤ 0.6 samples 1 → AP = 61/101.
+        gt = [g(1, (30 * i, 0, 10, 10)) for i in range(5)]
+        dt = [
+            d(1, (0, 0, 10, 10), 0.9),
+            d(1, (30, 0, 10, 10), 0.8),
+            d(1, (60, 0, 10, 10), 0.7),
+        ]
+        m = both(gt, dt)
+        np.testing.assert_allclose(m["AP"], 61 / 101, atol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# Brute-force property test on random scenes
+# ---------------------------------------------------------------------------
+
+
+def random_scene(seed):
+    rng = np.random.default_rng(seed)
+    n_imgs = int(rng.integers(1, 5))
+    n_cats = int(rng.integers(1, 4))
+    gts, dts = [], []
+    for img in range(1, n_imgs + 1):
+        for cat in range(1, n_cats + 1):
+            for _ in range(int(rng.integers(0, 5))):
+                x, y = rng.uniform(0, 60, 2)
+                w, h = rng.uniform(2, 60, 2)
+                area = w * h if rng.random() < 0.7 else float(rng.uniform(1, 1e4))
+                gts.append(
+                    g(
+                        img,
+                        (x, y, w, h),
+                        cat=cat,
+                        area=area,
+                        iscrowd=int(rng.random() < 0.2),
+                        ignore=int(rng.random() < 0.1),
+                    )
+                )
+            for _ in range(int(rng.integers(0, 7))):
+                if gts and rng.random() < 0.5:
+                    # Perturb a gt box: realistic near-matches at varied IoU.
+                    base = gts[int(rng.integers(0, len(gts)))]["bbox"]
+                    x, y, w, h = (
+                        np.asarray(base) + rng.normal(0, 4, 4)
+                    ).tolist()
+                    w, h = max(w, 1.0), max(h, 1.0)
+                else:
+                    x, y = rng.uniform(0, 60, 2)
+                    w, h = rng.uniform(2, 60, 2)
+                # Coarse scores force plenty of exact ties.
+                score = round(float(rng.uniform(0.05, 1.0)), 1)
+                dts.append(d(img, (x, y, w, h), score, cat=cat))
+    return gts, dts
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_random_scenes_match_brute_force(seed):
+    gts, dts = random_scene(seed)
+    if not gts and not dts:
+        pytest.skip("empty scene")
+    both(gts, dts)
+
+
+def test_many_detections_beyond_maxdets():
+    # 150 dets in one (image, category): only the top-100 by score may
+    # count — truncation happens before matching, not after.
+    rng = np.random.default_rng(7)
+    gts = [g(1, (20 * i, 0, 15, 15)) for i in range(6)]
+    dts = []
+    for i in range(150):
+        x = float(rng.uniform(0, 120))
+        dts.append(d(1, (x, rng.uniform(0, 30), 15, 15), float(rng.uniform(0, 1))))
+    both(gts, dts)

@@ -24,7 +24,9 @@ from batchai_retinanet_horovod_coco_tpu.data.pascal_voc import (
 from batchai_retinanet_horovod_coco_tpu.data.pipeline import (
     Batch,
     PipelineConfig,
+    PipelineStats,
     build_pipeline,
+    resolve_max_gt,
 )
 from batchai_retinanet_horovod_coco_tpu.data.synthetic import make_synthetic_coco
 from batchai_retinanet_horovod_coco_tpu.data.transforms import TransformConfig
@@ -36,8 +38,10 @@ __all__ = [
     "ImageRecord",
     "PascalVocDataset",
     "PipelineConfig",
+    "PipelineStats",
     "VOC_CLASSES",
     "TransformConfig",
     "build_pipeline",
+    "resolve_max_gt",
     "make_synthetic_coco",
 ]

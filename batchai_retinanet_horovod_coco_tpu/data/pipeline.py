@@ -27,6 +27,7 @@ TPU-first redesign decisions:
 from __future__ import annotations
 
 import dataclasses
+import logging
 import queue
 import threading
 from collections.abc import Iterator
@@ -34,6 +35,8 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import NamedTuple
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 from batchai_retinanet_horovod_coco_tpu.data.transforms import cv2  # shared fallback
 
@@ -95,6 +98,40 @@ class PipelineConfig:
     # Default: ship uint8 and normalize ON DEVICE (see normalize_images).
     # True restores the reference's host-side f32 preprocessing.
     host_normalize: bool = False
+
+
+def dataset_max_gt(dataset) -> int:
+    """Largest per-image annotation count in the dataset (crowds excluded —
+    only ``record.boxes`` feed training targets)."""
+    return max((len(r.boxes) for r in dataset.records), default=0)
+
+
+def resolve_max_gt(requested: int | None, *datasets, cap: int = 512) -> int:
+    """The pipeline's gt-padding size for a run.
+
+    ``None`` (auto) sizes to the datasets' true per-image maximum — no
+    silent truncation, COCO images can carry >100 boxes — rounded up to a
+    multiple of 8 for layout friendliness and clamped to [8, cap].  An
+    explicit value is honored as-is; ``build_pipeline`` then counts and
+    logs what it drops.
+    """
+    if requested is not None:
+        return requested
+    need = max((dataset_max_gt(ds) for ds in datasets), default=0)
+    return max(8, min(round_up(max(need, 1), 8), cap))
+
+
+@dataclasses.dataclass
+class PipelineStats:
+    """Mutable counters a pipeline exposes (``.stats`` on the iterator).
+
+    Truncation means an image carried more than ``max_gt`` boxes: the
+    overflow boxes vanish from the training targets (their anchors become
+    background and are actively penalized), so it must be visible.
+    """
+
+    truncated_boxes: int = 0
+    truncated_images: int = 0
 
 
 class Batch(NamedTuple):
@@ -203,6 +240,7 @@ def _assemble(
     image_ids: list[int],
     bucket: tuple[int, int],
     config: PipelineConfig,
+    stats: PipelineStats | None = None,
 ) -> Batch:
     b = len(examples)
     bh, bw = bucket
@@ -220,6 +258,9 @@ def _assemble(
         h, w = img.shape[:2]
         images[i, :h, :w] = img
         n = min(len(boxes), config.max_gt)
+        if stats is not None and len(boxes) > n:
+            stats.truncated_boxes += len(boxes) - n
+            stats.truncated_images += 1
         gt_boxes[i, :n] = boxes[:n]
         gt_labels[i, :n] = labels[:n]
         gt_mask[i, :n] = True
@@ -235,17 +276,44 @@ def _assemble(
     )
 
 
+class _PipelineIterator:
+    """Iterator over batches exposing live ``stats`` (PipelineStats)."""
+
+    def __init__(self, gen: Iterator[Batch], stats: PipelineStats):
+        self._gen = gen
+        self.stats = stats
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Batch:
+        return next(self._gen)
+
+    def close(self) -> None:
+        """Stop the producer thread (generator-close semantics)."""
+        self._gen.close()
+
+
 def build_pipeline(
     dataset: CocoDataset,
     config: PipelineConfig,
     train: bool = True,
-) -> Iterator[Batch]:
+) -> _PipelineIterator:
     """Infinite (train) or single-epoch (eval) iterator of bucketed batches.
 
     Train: shuffles per epoch, groups records by bucket, yields full batches.
     Eval: preserves order, no augmentation, pads the final batch with
     ``valid=False`` rows so every record is evaluated exactly once.
     """
+    stats = PipelineStats()
+    over = sum(1 for r in dataset.records if len(r.boxes) > config.max_gt)
+    if over:
+        logger.warning(
+            "max_gt=%d truncates %d/%d images (dataset max %d boxes/image); "
+            "overflow boxes are DROPPED from training targets. Pass an "
+            "explicit larger --max-gt to keep them.",
+            config.max_gt, over, len(dataset.records), dataset_max_gt(dataset),
+        )
 
     def example_rng(epoch: int, idx: int) -> np.random.Generator | None:
         if not train:
@@ -309,7 +377,7 @@ def build_pipeline(
             def flush_one() -> bool:
                 futures, ids, bucket, short = inflight.popleft()
                 examples = [f.result() for f in futures]
-                batch = _assemble(examples, ids, bucket, config)
+                batch = _assemble(examples, ids, bucket, config, stats)
                 if short:
                     batch = _pad_batch(batch, config.batch_size)
                 return _put(batch)
@@ -368,7 +436,7 @@ def build_pipeline(
         finally:
             stop.set()
 
-    return iterate()
+    return _PipelineIterator(iterate(), stats)
 
 
 def _pad_batch(batch: Batch, batch_size: int) -> Batch:

@@ -234,6 +234,12 @@ def run_training(
             scalars["data_wait_ms"] = (
                 window_data_wait / max(window_steps, 1) * 1e3
             )
+            # Cumulative gt boxes dropped by max_gt padding (pipeline
+            # counter) — silent truncation poisons targets, so it is a
+            # first-class metric whenever it is nonzero.
+            pipe_stats = getattr(batches, "stats", None)
+            if pipe_stats is not None and pipe_stats.truncated_boxes:
+                scalars["truncated_gt_boxes"] = pipe_stats.truncated_boxes
             if schedule is not None:
                 scalars["lr"] = float(schedule(step - 1))
                 scale = optim.plateau_scale(state.opt_state)

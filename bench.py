@@ -28,6 +28,24 @@ BUCKET = (800, 1344)
 WARMUP_STEPS = 3
 MEASURE_STEPS = 10
 
+# Peak dense bf16 TFLOP/s per chip by device kind (public spec sheets);
+# used only to report MFU next to the throughput number.
+_PEAK_TFLOPS = (
+    ("v5 lite", 197.0),  # v5e
+    ("v5e", 197.0),
+    ("v5p", 459.0),
+    ("v4", 275.0),
+    ("v6", 918.0),  # Trillium
+)
+
+
+def _device_peak_tflops() -> float | None:
+    kind = jax.devices()[0].device_kind.lower()
+    for sub, peak in _PEAK_TFLOPS:
+        if sub in kind:
+            return peak
+    return None
+
 
 def make_batch(batch_size: int, hw: tuple[int, int], max_gt: int = 100):
     rng = np.random.default_rng(0)
@@ -55,7 +73,7 @@ def make_batch(batch_size: int, hw: tuple[int, int], max_gt: int = 100):
     }
 
 
-def run_bench(batch_size: int) -> float:
+def run_bench(batch_size: int) -> tuple[float, float | None]:
     from batchai_retinanet_horovod_coco_tpu.models import (
         RetinaNetConfig,
         build_retinanet,
@@ -80,17 +98,39 @@ def run_bench(batch_size: int) -> float:
     step = make_train_step(model, BUCKET, 80, donate_state=True)
     batch = make_batch(batch_size, BUCKET)
 
+    # AOT-compile once: the executable both runs the loop and reports the
+    # XLA-counted FLOPs of the whole step (forward, assignment, losses,
+    # backward, update) for the MFU number.
+    compiled = step.lower(state, batch).compile()
+    cost = compiled.cost_analysis()
+    if isinstance(cost, list):
+        cost = cost[0] if cost else None
+    step_flops = float(cost.get("flops", 0.0)) if cost else 0.0
+
     for _ in range(WARMUP_STEPS):
-        state, metrics = step(state, batch)
-    jax.block_until_ready(metrics)
+        state, metrics = compiled(state, batch)
+    # Same hard sync as the timed region: block_until_ready can return
+    # early on tunneled backends, which would leak warmup work into t0.
+    float(metrics["loss"])
 
     t0 = time.perf_counter()
     for _ in range(MEASURE_STEPS):
-        state, metrics = step(state, batch)
-    jax.block_until_ready(metrics)
+        state, metrics = compiled(state, batch)
+    # Hard sync INSIDE the timed region: on tunneled backends,
+    # block_until_ready on jit-call results can return before the device
+    # finishes (measured 2 ms/step "throughput" on a 376 ms step); pulling
+    # a scalar to host cannot lie.
+    loss = float(metrics["loss"])
     dt = time.perf_counter() - t0
-    assert np.isfinite(float(metrics["loss"]))
-    return batch_size * MEASURE_STEPS / dt
+    assert np.isfinite(loss)
+
+    ips = batch_size * MEASURE_STEPS / dt
+    peak = _device_peak_tflops()
+    mfu = None
+    if step_flops > 0 and peak:
+        achieved_tflops = step_flops * (MEASURE_STEPS / dt) / 1e12
+        mfu = achieved_tflops / peak
+    return ips, mfu
 
 
 def first_recorded_bench() -> float | None:
@@ -101,7 +141,11 @@ def first_recorded_bench() -> float | None:
             continue
         try:
             with open(path) as f:
-                vals[int(m.group(1))] = float(json.load(f)["value"])
+                data = json.load(f)
+            # The driver wraps the printed line under "parsed".
+            if "value" not in data and "parsed" in data:
+                data = data["parsed"]
+            vals[int(m.group(1))] = float(data["value"])
         except Exception:
             continue
     return vals[min(vals)] if vals else None
@@ -110,7 +154,7 @@ def first_recorded_bench() -> float | None:
 def main() -> None:
     batch_size = int(os.environ.get("BENCH_BATCH", "8"))
     try:
-        ips = run_bench(batch_size)
+        ips, mfu = run_bench(batch_size)
     except Exception as e:
         # Retry smaller only for HBM exhaustion; real bugs propagate.
         oom = "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e)
@@ -118,7 +162,7 @@ def main() -> None:
             raise
         print(f"# batch {batch_size} OOM; retrying at 2", flush=True)
         batch_size = 2
-        ips = run_bench(batch_size)
+        ips, mfu = run_bench(batch_size)
 
     baseline = first_recorded_bench()
     value = round(ips, 3)
@@ -129,6 +173,7 @@ def main() -> None:
                 "value": value,
                 "unit": "images/sec/chip",
                 "vs_baseline": round(value / baseline, 4) if baseline else 1.0,
+                "mfu": round(mfu, 4) if mfu is not None else None,
             }
         )
     )

@@ -36,7 +36,8 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--limit", type=int, default=8)
         sp.add_argument("--image-min-side", type=int, default=800)
         sp.add_argument("--image-max-side", type=int, default=1333)
-        sp.add_argument("--max-gt", type=int, default=100)
+        sp.add_argument("--max-gt", type=int, default=None,
+                        help="gt padding; default auto-sizes to the dataset")
         sp.add_argument("--output-dir", default=None)
     return p
 
@@ -70,14 +71,18 @@ def main(argv=None) -> list[dict]:
             os.path.join(args.coco_path, args.images),
         )
 
-    from batchai_retinanet_horovod_coco_tpu.data.pipeline import default_buckets
+    from batchai_retinanet_horovod_coco_tpu.data.pipeline import (
+        default_buckets,
+        resolve_max_gt,
+    )
 
     buckets = default_buckets(args.image_min_side, args.image_max_side)
     pipe = build_pipeline(
         dataset,
         PipelineConfig(
             batch_size=1, buckets=buckets, min_side=args.image_min_side,
-            max_side=args.image_max_side, max_gt=args.max_gt,
+            max_side=args.image_max_side,
+            max_gt=resolve_max_gt(args.max_gt, dataset),
             shuffle=False, hflip_prob=0.0, num_workers=2,
         ),
         train=False,

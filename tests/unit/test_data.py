@@ -223,3 +223,76 @@ def test_determinism_same_seed(synthetic_dataset):
     np.testing.assert_array_equal(a.image_ids, b.image_ids)
     np.testing.assert_allclose(a.images, b.images)
     np.testing.assert_allclose(a.gt_boxes, b.gt_boxes)
+
+
+class _ManyBoxDataset:
+    """Duck-typed dataset: one image carrying ``n`` gt boxes."""
+
+    def __init__(self, root, n=150, size=96):
+        from PIL import Image
+        from batchai_retinanet_horovod_coco_tpu.data.coco import ImageRecord
+
+        rng = np.random.default_rng(0)
+        path = f"{root}/img.jpg"
+        Image.fromarray(
+            rng.integers(0, 255, (size, size, 3), dtype=np.uint8)
+        ).save(path)
+        xy = rng.uniform(0, size - 10, (n, 2)).astype(np.float32)
+        boxes = np.concatenate([xy, xy + rng.uniform(4, 10, (n, 2))], 1)
+        boxes = np.clip(boxes, 0, size).astype(np.float32)
+        self.records = [
+            ImageRecord(
+                image_id=1, file_name="img.jpg", width=size, height=size,
+                boxes=boxes, labels=np.zeros(n, np.int32),
+                areas=((boxes[:, 2] - boxes[:, 0]) * (boxes[:, 3] - boxes[:, 1])),
+                crowd_boxes=np.zeros((0, 4), np.float32),
+                crowd_labels=np.zeros(0, np.int32),
+                crowd_areas=np.zeros(0, np.float32),
+            )
+        ]
+        self._root = root
+
+    def image_path(self, record):
+        return f"{self._root}/{record.file_name}"
+
+
+def test_resolve_max_gt_auto_covers_dataset(tmp_path):
+    from batchai_retinanet_horovod_coco_tpu.data import resolve_max_gt
+
+    ds = _ManyBoxDataset(str(tmp_path), n=150)
+    max_gt = resolve_max_gt(None, ds)
+    assert max_gt >= 150
+    # All 150 boxes survive into the batch.
+    batches = build_pipeline(
+        ds,
+        PipelineConfig(
+            batch_size=1, buckets=((96, 96),), min_side=96, max_side=96,
+            max_gt=max_gt, num_workers=1, shuffle=False,
+        ),
+        train=False,
+    )
+    batch = next(iter(batches))
+    assert int(batch.gt_mask.sum()) == 150
+    assert batches.stats.truncated_boxes == 0
+    # Explicit values are honored unchanged.
+    assert resolve_max_gt(100, ds) == 100
+
+
+def test_max_gt_truncation_is_counted_and_warned(tmp_path, caplog):
+    import logging
+
+    ds = _ManyBoxDataset(str(tmp_path), n=150)
+    with caplog.at_level(logging.WARNING, logger="batchai_retinanet_horovod_coco_tpu.data.pipeline"):
+        batches = build_pipeline(
+            ds,
+            PipelineConfig(
+                batch_size=1, buckets=((96, 96),), min_side=96, max_side=96,
+                max_gt=100, num_workers=1, shuffle=False,
+            ),
+            train=False,
+        )
+        batch = next(iter(batches))
+    assert int(batch.gt_mask.sum()) == 100
+    assert batches.stats.truncated_boxes == 50
+    assert batches.stats.truncated_images == 1
+    assert any("truncates" in r.message for r in caplog.records)

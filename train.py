@@ -143,7 +143,10 @@ def build_parser() -> argparse.ArgumentParser:
                        help="GLOBAL batch size (split over devices)")
         g.add_argument("--image-min-side", type=int, default=800)
         g.add_argument("--image-max-side", type=int, default=1333)
-        g.add_argument("--max-gt", type=int, default=100)
+        g.add_argument("--max-gt", type=int, default=None,
+                       help="gt boxes padded per image; default auto-sizes "
+                            "to the dataset's true per-image max (COCO "
+                            "images can exceed 100) so no box is dropped")
         g.add_argument("--workers", type=int, default=16,
                        help="decode threads; TPU-VM hosts have ~112 vCPUs "
                             "and need ~1 core per 3 imgs/s of step demand")
@@ -309,7 +312,11 @@ def main(argv=None) -> dict[str, float]:
                 ).strip()
         jax.config.update("jax_platforms", args.platform)
 
-    from batchai_retinanet_horovod_coco_tpu.data import PipelineConfig, build_pipeline
+    from batchai_retinanet_horovod_coco_tpu.data import (
+        PipelineConfig,
+        build_pipeline,
+        resolve_max_gt,
+    )
     from batchai_retinanet_horovod_coco_tpu.evaluate.detect import (
         DetectConfig,
         run_coco_eval,
@@ -349,6 +356,11 @@ def main(argv=None) -> dict[str, float]:
 
     train_ds, val_ds = make_datasets(args)
     num_classes = train_ds.num_classes
+    # Auto-size gt padding to the data (silent truncation poisons targets);
+    # an explicit --max-gt is honored and the pipeline counts what it drops.
+    args.max_gt = resolve_max_gt(
+        args.max_gt, *(ds for ds in (train_ds, val_ds) if ds is not None)
+    )
     if val_ds is None and (args.eval_only or args.eval_every):
         raise SystemExit(
             "no validation set: pass --val-csv-annotations to evaluate"

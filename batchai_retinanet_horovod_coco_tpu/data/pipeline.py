@@ -279,8 +279,11 @@ def _assemble(
 class _PipelineIterator:
     """Iterator over batches exposing live ``stats`` (PipelineStats)."""
 
-    def __init__(self, gen: Iterator[Batch], stats: PipelineStats):
+    def __init__(
+        self, gen: Iterator[Batch], stats: PipelineStats, stop: threading.Event
+    ):
         self._gen = gen
+        self._stop = stop
         self.stats = stats
 
     def __iter__(self):
@@ -290,7 +293,12 @@ class _PipelineIterator:
         return next(self._gen)
 
     def close(self) -> None:
-        """Stop the producer thread (generator-close semantics)."""
+        """Stop the producer thread.
+
+        Signals the stop event directly (generator ``.close()`` alone is a
+        no-op on a never-started generator, which would leak the producer).
+        """
+        self._stop.set()
         self._gen.close()
 
 
@@ -436,7 +444,7 @@ def build_pipeline(
         finally:
             stop.set()
 
-    return _PipelineIterator(iterate(), stats)
+    return _PipelineIterator(iterate(), stats, stop)
 
 
 def _pad_batch(batch: Batch, batch_size: int) -> Batch:

@@ -46,10 +46,24 @@ class StemConv(nn.Module):
     checkpoints and the torch-weight importer (models/import_weights.py) are
     mode-independent; the kernel reshape is 9k elements and folds into XLA's
     constant/weight preprocessing.
+
+    ``block=4`` folds 4x4 tiles (48-channel contraction, both MXU sides well
+    fed) and emits each block's two stride-2 outputs as channels, unfolded
+    depth-to-space after.  MEASURED (v5e-1, flagship b8 train step): 140.9 ms
+    vs 135.1 ms for ``block=2`` — the zero-padded kernel does 2.9x the MACs
+    and the (B, H/4, W/4, 256) output shuffle is extra bandwidth, which
+    together outweigh the packing gain.  Kept as an exact, tested
+    reformulation in case future hardware shifts the tradeoff; ``block=2``
+    stays the default.
     """
 
     features: int = 64
     space_to_depth: bool = False
+    # Fold size when space_to_depth: 2 folds 2x2 pixel blocks (12-channel
+    # contraction), 4 folds 4x4 blocks (48 channels, both MXU sides well fed
+    # — measured numbers in the class docstring) and emits both stride-2
+    # outputs of each block as channels, unfolded depth-to-space after.
+    block: int = 2
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
@@ -79,27 +93,73 @@ class StemConv(nn.Module):
             )
 
         b, h, w, _ = x.shape
-        if h % 2 or w % 2:
+        if h % self.block or w % self.block:
             raise ValueError(
-                f"space_to_depth stem needs even H, W; got {(h, w)}"
+                f"space_to_depth({self.block}) stem needs H, W divisible by "
+                f"{self.block}; got {(h, w)}"
             )
-        # Input: fold 2x2 pixel blocks into channels, (p_h, p_w, c) order.
-        x = x.reshape(b, h // 2, 2, w // 2, 2, c_in)
-        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * c_in)
-        # Kernel: pad 7→8 taps (last tap zero), split each spatial dim into
-        # (block, within-block) and fold within-block into input channels in
-        # the SAME (p_h, p_w, c) order.  out[j] = Σ_r x[2j-2+r]·w[r] becomes
-        # a 4-tap block conv starting at block j-1 → padding (1, 2).
-        k = jnp.pad(kernel, ((0, 1), (0, 1), (0, 0), (0, 0)))
-        k = k.reshape(4, 2, 4, 2, c_in, self.features)
-        k = k.transpose(0, 2, 1, 3, 4, 5).reshape(4, 4, 4 * c_in, self.features)
-        return lax.conv_general_dilated(
+        # Input: fold block x block pixel tiles into channels, (p_h, p_w, c)
+        # order.
+        s = self.block
+        x = x.reshape(b, h // s, s, w // s, s, c_in)
+        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // s, w // s, s * s * c_in)
+        if s == 2:
+            # Kernel: pad 7→8 taps (last tap zero), split each spatial dim
+            # into (block, within-block) and fold within-block into input
+            # channels in the SAME (p_h, p_w, c) order.  out[j] =
+            # Σ_r x[2j-2+r]·w[r] becomes a 4-tap block conv starting at
+            # block j-1 → padding (1, 2).
+            k = jnp.pad(kernel, ((0, 1), (0, 1), (0, 0), (0, 0)))
+            k = k.reshape(4, 2, 4, 2, c_in, self.features)
+            k = k.transpose(0, 2, 1, 3, 4, 5).reshape(
+                4, 4, 4 * c_in, self.features
+            )
+            return lax.conv_general_dilated(
+                x,
+                k.astype(self.dtype),
+                window_strides=(1, 1),
+                padding=((1, 2), (1, 2)),
+                dimension_numbers=dn,
+            )
+        if s != 4:
+            raise ValueError(f"space_to_depth block must be 2 or 4, got {s}")
+        # 4x4 fold: each block carries TWO stride-2 outputs per spatial dim,
+        # emitted as extra output channels and unfolded depth-to-space below.
+        # With SAME padding the stride-2 conv is out[i] = Σ_t w[t]·x[2i+t-2]
+        # (t = 0..6); writing i = 2j+u (u ∈ {0,1} within block j) and
+        # x-index = 4(j+β)+r (β block tap, r ∈ 0..3 within block) gives
+        #   t = 4β + r - 2u + 2,
+        # a 3-tap block conv (β ∈ {-1,0,1}, padding (1,1)) whose folded
+        # kernel gathers the original tap t where valid and zero elsewhere.
+        beta = jnp.arange(3) - 1  # block taps
+        r = jnp.arange(4)
+        u = jnp.arange(2)
+        t = (4 * beta[:, None, None] + r[None, :, None]
+             - 2 * u[None, None, :] + 2)  # (β, r, u)
+        valid = (t >= 0) & (t <= 6)
+        t = jnp.where(valid, t, 7)  # 7 = the zero-padded tap
+        kp = jnp.pad(kernel, ((0, 1), (0, 1), (0, 0), (0, 0)))  # (8,8,c,f)
+        # Gather → (βh, rh, uh, βw, rw, uw, c, f), then order in-channels as
+        # (rh, rw, c) [matching the input fold] and out-channels as
+        # (uh, uw, f) [matching the depth-to-space unfold].
+        k = kp[t[:, :, :, None, None, None], t[None, None, None, :, :, :]]
+        k = k.transpose(0, 3, 1, 4, 6, 2, 5, 7).reshape(
+            3, 3, 16 * c_in, 4 * self.features
+        )
+        y = lax.conv_general_dilated(
             x,
             k.astype(self.dtype),
             window_strides=(1, 1),
-            padding=((1, 2), (1, 2)),
+            padding=((1, 1), (1, 1)),
             dimension_numbers=dn,
         )
+        # Depth-to-space: (B, h/4, w/4, (uh, uw, f)) → (B, h/2, w/2, f).
+        bh, bw = h // 4, w // 4
+        y = y.reshape(b, bh, bw, 2, 2, self.features)
+        y = y.transpose(0, 1, 3, 2, 4, 5).reshape(
+            b, 2 * bh, 2 * bw, self.features
+        )
+        return y
 
 
 class NormFactory:
@@ -167,15 +227,18 @@ class ResNet(nn.Module):
     stage_sizes: Sequence[int]
     norm_kind: str = "gn"
     dtype: jnp.dtype = jnp.bfloat16
-    stem: str = "conv"  # "conv" | "space_to_depth" (see StemConv)
+    stem: str = "conv"  # "conv" | "space_to_depth" | "space_to_depth4"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> dict[str, jnp.ndarray]:
+        if self.stem not in ("conv", "space_to_depth", "space_to_depth4"):
+            raise ValueError(f"unknown stem: {self.stem!r}")
         norm = NormFactory(self.norm_kind, self.dtype)
         x = x.astype(self.dtype)
         x = StemConv(
             features=64,
-            space_to_depth=self.stem == "space_to_depth",
+            space_to_depth=self.stem != "conv",
+            block=4 if self.stem == "space_to_depth4" else 2,
             dtype=self.dtype,
             name="stem_conv",
         )(x)

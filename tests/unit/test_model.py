@@ -144,6 +144,30 @@ class TestSpaceToDepthStem:
             np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5
         )
 
+    def test_block4_equivalent_to_plain_stem(self):
+        """The 4x4 fold (two stride-2 outputs per block as channels +
+        depth-to-space) must also be EXACTLY the 7x7/2 conv."""
+        from batchai_retinanet_horovod_coco_tpu.models.resnet import StemConv
+
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(0, 1, (2, 64, 96, 3)).astype(np.float32))
+        plain = StemConv(space_to_depth=False, dtype=jnp.float32)
+        s2d4 = StemConv(space_to_depth=True, block=4, dtype=jnp.float32)
+        params = plain.init(jax.random.key(0), x)
+        a = jax.jit(plain.apply)(params, x)
+        b = jax.jit(s2d4.apply)(params, x)
+        assert a.shape == b.shape == (2, 32, 48, 64)
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5
+        )
+
+    def test_block4_rejects_indivisible(self):
+        from batchai_retinanet_horovod_coco_tpu.models.resnet import StemConv
+
+        x = jnp.zeros((1, 66, 64, 3), jnp.float32)
+        with pytest.raises(ValueError, match="divisible by 4"):
+            StemConv(space_to_depth=True, block=4).init(jax.random.key(0), x)
+
     def test_param_layout_is_mode_independent(self):
         """Checkpoints / torch imports see (7,7,3,64) in both modes."""
         from batchai_retinanet_horovod_coco_tpu.models.resnet import StemConv
@@ -157,7 +181,7 @@ class TestSpaceToDepthStem:
         from batchai_retinanet_horovod_coco_tpu.models.resnet import StemConv
 
         x = jnp.zeros((1, 33, 32, 3), jnp.float32)
-        with pytest.raises(ValueError, match="even"):
+        with pytest.raises(ValueError, match="divisible by 2"):
             StemConv(space_to_depth=True).init(jax.random.key(0), x)
 
     def test_plain_stem_same_padding_odd_dims(self):

@@ -127,7 +127,7 @@ def build_parser() -> argparse.ArgumentParser:
         g.add_argument("--backbone", default="resnet50", choices=BACKBONES)
         g.add_argument("--norm", default="gn", choices=["gn", "bn", "frozen_bn"])
         g.add_argument("--stem", default="space_to_depth",
-                       choices=["conv", "space_to_depth"],
+                       choices=["conv", "space_to_depth", "space_to_depth4"],
                        help="stem formulation; space_to_depth is the "
                             "math-identical MLPerF reformulation, ~4%% "
                             "faster on TPU (models/resnet.py)")

@@ -258,6 +258,62 @@ def collect_detections(
     return results
 
 
+def allgather_process_detections(results: list[dict]) -> list[dict]:
+    """Merge per-process detection shards across hosts.
+
+    The sharded-eval gather: each process detects only ITS slice of the val
+    set (the reference evaluated on rank 0 only — at pod scale that is
+    hosts× redundant decode, SURVEY.md M10); the COCO result dicts pack into
+    a fixed-width float64 array, pad to the max per-process count, and
+    all-gather at the host level.  Every process returns the full merged
+    list, so the subsequent scoring is identical everywhere (process 0
+    logs).  Single-process: identity.
+    """
+    if jax.process_count() == 1:
+        return results
+    from jax.experimental import multihost_utils
+
+    # Two packs: int64 ids would be canonicalized to int32 (and float64 to
+    # float32) without jax_enable_x64, so 64-bit image ids (date-encoded COCO
+    # ids are legal) travel as uint32 (lo, hi) halves; bbox/score are f32 on
+    # device anyway, so the f32 pack loses nothing vs the unsharded path.
+    n = len(results)
+    ids = np.zeros((n, 3), np.uint32)  # image_id lo/hi, category_id
+    vals = np.zeros((n, 5), np.float32)  # bbox xywh, score
+    for i, r in enumerate(results):
+        image_id = int(r["image_id"])
+        ids[i] = [image_id & 0xFFFFFFFF, image_id >> 32, r["category_id"]]
+        vals[i] = [*r["bbox"], r["score"]]
+    counts = np.asarray(
+        multihost_utils.process_allgather(np.uint32(n))
+    ).reshape(-1)
+    n_max = int(counts.max())
+    if n_max == 0:
+        return []
+    ids_g = np.asarray(
+        multihost_utils.process_allgather(
+            np.pad(ids, ((0, n_max - n), (0, 0)))
+        )
+    )
+    vals_g = np.asarray(
+        multihost_utils.process_allgather(
+            np.pad(vals, ((0, n_max - n), (0, 0)))
+        )
+    )
+    merged: list[dict] = []
+    for p in range(ids_g.shape[0]):
+        for j in range(int(counts[p])):
+            merged.append(
+                {
+                    "image_id": int(ids_g[p, j, 0]) | (int(ids_g[p, j, 1]) << 32),
+                    "category_id": int(ids_g[p, j, 2]),
+                    "bbox": [float(v) for v in vals_g[p, j, :4]],
+                    "score": float(vals_g[p, j, 4]),
+                }
+            )
+    return merged
+
+
 def run_coco_eval(
     state,
     model,
@@ -267,6 +323,7 @@ def run_coco_eval(
     mesh: Mesh | None = None,
     voc_metrics: bool = False,
     voc_weighted_average: bool = False,
+    gather: bool = True,
 ) -> dict[str, float]:
     """Full eval pass: detect everything, then mAP via the numpy oracle.
 
@@ -275,8 +332,15 @@ def run_coco_eval(
     metric for CSV/custom datasets, evaluate/voc_eval.py), merged into the
     returned dict under ``voc_*`` keys; ``voc_weighted_average`` weights
     the VOC mean by per-class annotation counts (the callback's flag).
+
+    Multi-host: feed each process its shard of the val set (pipeline
+    ``shard_index/shard_count``), detect on a LOCAL mesh, and the shards
+    merge here via ``allgather_process_detections`` (``gather=False`` skips
+    the merge for a deliberately process-local eval).
     """
     dt = collect_detections(state, model, dataset, batches, config, mesh=mesh)
+    if gather:
+        dt = allgather_process_detections(dt)
     gt, img_ids = coco_gt_from_dataset(dataset)
     metrics = evaluate_detections(gt, dt, img_ids=img_ids)
     if voc_metrics:

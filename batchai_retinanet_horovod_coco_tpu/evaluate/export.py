@@ -110,6 +110,10 @@ def export_model(
             "pre_nms_size": config.pre_nms_size,
             "max_detections": config.max_detections,
         },
+        # Anchors parameterize box decoding INSIDE the artifact; recorded so
+        # the artifact is self-describing (a consumer regenerating anchors,
+        # e.g. for target assignment, must use these, not the defaults).
+        "anchor_config": dataclasses.asdict(config.anchor),
         "class_names": class_names,
         "label_to_cat_id": (
             {str(k): v for k, v in label_to_cat_id.items()}

@@ -1,0 +1,106 @@
+"""DenseNet-BC backbone exposing C3, C4, C5 (strides 8/16/32).
+
+Parity target: keras-retinanet's densenet backbone family
+(``keras_retinanet/models/densenet.py`` — densenet121/169/201 as RetinaNet
+backbones, the last of the reference's era backbone families, SURVEY.md M2).
+Rebuilt in flax: BC variant (1x1 bottleneck to 4·growth before every 3x3,
+transitions with 0.5 compression), growth rate 32.
+
+Feature taps follow the torchvision/keras convention for detection: each
+dense block's concatenated output (after the shared norm+relu) BEFORE the
+transition that downsamples for the next block — block2 @ stride 8 (c3),
+block3 @ stride 16 (c4), block4 + final norm @ stride 32 (c5).
+
+TPU note: dense connectivity concatenates along channels, so the 3x3 convs
+contract over ever-wider inputs (MXU-friendly) but every block re-reads the
+whole growing feature map — bandwidth-heavier per FLOP than ResNet.  NHWC,
+bf16 activations / f32 params, same norm factory as ResNet.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from batchai_retinanet_horovod_coco_tpu.models.resnet import NormFactory
+
+# block sizes per variant (growth 32, init 64, compression 0.5)
+DENSENET_STAGES = {
+    "densenet121": (6, 12, 24, 16),
+    "densenet169": (6, 12, 32, 32),
+    "densenet201": (6, 12, 48, 32),
+}
+
+
+class _DenseLayer(nn.Module):
+    """norm → relu → 1x1 (4·growth) → norm → relu → 3x3 (growth); concat."""
+
+    growth: int
+    norm: NormFactory
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        y = self.norm("norm1", train)(x)
+        y = nn.relu(y)
+        y = nn.Conv(
+            4 * self.growth, (1, 1), use_bias=False,
+            dtype=self.dtype, param_dtype=jnp.float32, name="conv1",
+        )(y)
+        y = self.norm("norm2", train)(y)
+        y = nn.relu(y)
+        y = nn.Conv(
+            self.growth, (3, 3), padding="SAME", use_bias=False,
+            dtype=self.dtype, param_dtype=jnp.float32, name="conv2",
+        )(y)
+        return jnp.concatenate([x, y], axis=-1)
+
+
+class DenseNet(nn.Module):
+    """DenseNet-BC; ``stage_sizes`` = layers per dense block (4 blocks)."""
+
+    stage_sizes: Sequence[int]
+    growth: int = 32
+    init_features: int = 64
+    norm_kind: str = "gn"
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(
+        self, x: jnp.ndarray, train: bool = False
+    ) -> dict[str, jnp.ndarray]:
+        norm = NormFactory(self.norm_kind, self.dtype)
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            self.init_features, (7, 7), strides=(2, 2), padding="SAME",
+            use_bias=False, dtype=self.dtype, param_dtype=jnp.float32,
+            name="stem_conv",
+        )(x)
+        x = norm("stem_norm", train)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")  # stride 4
+
+        features: dict[str, jnp.ndarray] = {}
+        for block, num_layers in enumerate(self.stage_sizes):
+            for layer in range(num_layers):
+                x = _DenseLayer(
+                    growth=self.growth, norm=norm, dtype=self.dtype,
+                    name=f"block{block + 1}_layer{layer}",
+                )(x, train=train)
+            # Shared norm+relu: tail of the block for the c-tap, head of the
+            # transition (or the final norm for the last block).
+            x = norm(f"block{block + 1}_out_norm", train)(x)
+            x = nn.relu(x)
+            # Blocks 2/3/4 run at strides 8/16/32 → c3/c4/c5.
+            if block >= 1:
+                features[f"c{block + 2}"] = x
+            if block < len(self.stage_sizes) - 1:
+                x = nn.Conv(
+                    x.shape[-1] // 2, (1, 1), use_bias=False,
+                    dtype=self.dtype, param_dtype=jnp.float32,
+                    name=f"transition{block + 1}_conv",
+                )(x)
+                x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        return features

@@ -61,7 +61,10 @@ _BACKBONE_STAGES = {
 
 BACKBONES = tuple(
     k for k, v in _BACKBONE_STAGES.items() if v is not None
-) + ("mobilenet", "mobilenet050", "vgg16", "vgg19")
+) + (
+    "mobilenet", "mobilenet050", "vgg16", "vgg19",
+    "densenet121", "densenet169", "densenet201",
+)
 
 
 def build_backbone(cfg: "RetinaNetConfig"):
@@ -99,6 +102,18 @@ def build_backbone(cfg: "RetinaNetConfig"):
 
         return VGG(
             stage_sizes=(2, 2, 3, 3, 3) if name == "vgg16" else (2, 2, 4, 4, 4),
+            dtype=cfg.dtype,
+            name="backbone",
+        )
+    if name in ("densenet121", "densenet169", "densenet201"):
+        from batchai_retinanet_horovod_coco_tpu.models.densenet import (
+            DENSENET_STAGES,
+            DenseNet,
+        )
+
+        return DenseNet(
+            stage_sizes=DENSENET_STAGES[name],
+            norm_kind=cfg.norm_kind,
             dtype=cfg.dtype,
             name="backbone",
         )

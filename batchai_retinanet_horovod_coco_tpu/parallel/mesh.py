@@ -33,6 +33,18 @@ def make_mesh(num_devices: int | None = None) -> Mesh:
     return Mesh(np.asarray(devices), axis_names=(DATA_AXIS,))
 
 
+def make_local_mesh() -> Mesh:
+    """1-D data mesh over THIS PROCESS's devices only.
+
+    For per-host work in a multi-host job — e.g. the sharded eval pass,
+    where each host detects its own slice of the val set on its own chips
+    and results merge via a host-level all-gather (evaluate/detect.py) —
+    compiled as an ordinary single-process program, no cross-host
+    collectives.
+    """
+    return Mesh(np.asarray(jax.local_devices()), axis_names=(DATA_AXIS,))
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Shard the leading (batch) axis over the data axis."""
     return NamedSharding(mesh, PartitionSpec(DATA_AXIS))

@@ -111,6 +111,7 @@ def run_training(
     mesh: Mesh | None = None,
     loss_config: losses_lib.LossConfig = losses_lib.LossConfig(),
     matching_config: matching_lib.MatchingConfig = matching_lib.MatchingConfig(),
+    anchor_config=None,
     schedule: Callable[[int], float] | None = None,
     eval_fn: Callable[[TrainState], dict[str, float]] | None = None,
     logger: MetricLogger | None = None,
@@ -145,6 +146,13 @@ def run_training(
                     "or corrupt — start fresh with --no-resume."
                 ) from e
             print(f"resumed from step {int(state.step)}", flush=True)
+            if jax.process_count() > 1:
+                # Restored arrays are COMMITTED to this process's devices; a
+                # device_put onto the global mesh from committed arrays would
+                # need cross-host transfers (unsupported on some backends).
+                # Every process restored identical values, so pull to host
+                # and let the replication below proceed host-locally.
+                state = jax.device_get(state)
 
     if mesh is not None:
         # Replicate state over the mesh (restored arrays land committed to a
@@ -205,6 +213,7 @@ def run_training(
                 mesh=mesh,
                 loss_config=loss_config,
                 matching_config=matching_config,
+                anchor_config=anchor_config,
                 shard_weight_update=shard_weight_update,
             )
         if config.profile_dir and step == prof_start:
@@ -225,6 +234,15 @@ def run_training(
             config.log_every and step % config.log_every == 0
         ) or step == config.total_steps:
             scalars = {k: v for k, v in jax.device_get(metrics).items()}
+            # Numerical sanitizer (SURVEY.md §5.2): a non-finite loss aborts
+            # with the offending step instead of silently training garbage.
+            if "loss" in scalars and not np.isfinite(scalars["loss"]):
+                raise FloatingPointError(
+                    f"non-finite loss ({float(scalars['loss'])}) at or "
+                    f"before step {step} (loss is checked every "
+                    f"{config.log_every or 1} steps); rerun with "
+                    "--debug-nans to locate the originating op"
+                )
             dt = time.perf_counter() - window_t0
             scalars["images_per_sec"] = window_images / max(dt, 1e-9)
             # Step-time breakdown (SURVEY.md §5.5): how much of the step the

@@ -1,0 +1,144 @@
+"""Shared CLI glue for anchor hyperparameters (train.py / convert_model.py /
+debug.py).
+
+keras-retinanet carried custom anchor parameters in a ``--config`` ini and
+baked them into the saved model (SURVEY.md M5/M11); here the equivalent is a
+single flag surface (``add_anchor_flags``) plus a JSON sidecar persisted next
+to the checkpoint (``save_anchor_config``), so eval/export/debug can never
+silently regenerate default anchors for a model trained with custom ones —
+anchors parameterize box decoding, so a mismatch produces garbage detections
+with no error anywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+
+from batchai_retinanet_horovod_coco_tpu.ops.anchors import AnchorConfig
+
+_ANCHOR_FILE = "anchor_config.json"
+_FLAG_FIELDS = ("sizes", "strides", "ratios", "scales")
+
+
+def float_list(text: str) -> tuple[float, ...]:
+    """argparse type for comma-separated floats ('32,64' → (32.0, 64.0))."""
+    try:
+        values = tuple(float(v) for v in text.split(",") if v.strip())
+    except ValueError:
+        raise argparse.ArgumentTypeError(f"not a float list: {text!r}")
+    if not values:
+        raise argparse.ArgumentTypeError("empty list")
+    return values
+
+
+def add_anchor_flags(parser) -> None:
+    """The anchor flag surface, identical on every tool that builds anchors."""
+    parser.add_argument("--anchor-sizes", type=float_list, default=None,
+                        metavar="S3,S4,S5,S6,S7",
+                        help="anchor base size per pyramid level "
+                             "(default 32,64,128,256,512)")
+    parser.add_argument("--anchor-strides", type=float_list, default=None,
+                        metavar="T3,T4,T5,T6,T7",
+                        help="anchor stride per pyramid level "
+                             "(default 8,16,32,64,128)")
+    parser.add_argument("--anchor-ratios", type=float_list, default=None,
+                        help="aspect ratios (default 0.5,1,2)")
+    parser.add_argument("--anchor-scales", type=float_list, default=None,
+                        help="octave scales (default 1,2^(1/3),2^(2/3))")
+
+
+def make_anchor_config(args) -> AnchorConfig:
+    """AnchorConfig from the CLI flags (defaults where flags are unset).
+
+    One config object threads through the model (head sizing), the train
+    step, detection, and export so they can never disagree.
+    """
+    default = AnchorConfig()
+    kw = {}
+    if args.anchor_sizes is not None:
+        kw["sizes"] = args.anchor_sizes
+    if args.anchor_strides is not None:
+        for s in args.anchor_strides:
+            if not float(s).is_integer():
+                raise SystemExit(
+                    f"--anchor-strides must be whole numbers, got {s}"
+                )
+        kw["strides"] = tuple(int(s) for s in args.anchor_strides)
+    if args.anchor_ratios is not None:
+        kw["ratios"] = args.anchor_ratios
+    if args.anchor_scales is not None:
+        kw["scales"] = args.anchor_scales
+    for key in ("sizes", "strides"):
+        if key in kw and len(kw[key]) != len(default.levels):
+            raise SystemExit(
+                f"--anchor-{key} needs {len(default.levels)} entries "
+                f"(one per pyramid level {default.levels}), got {len(kw[key])}"
+            )
+    return dataclasses.replace(default, **kw) if kw else default
+
+
+def save_anchor_config(snapshot_dir: str, config: AnchorConfig) -> None:
+    """Persist the anchor config next to the checkpoints (process 0 only).
+
+    Atomic (temp file + rename) and skipped when unchanged: peer processes
+    read this file at startup with no barrier in between, so a truncating
+    rewrite could be observed half-written.
+    """
+    os.makedirs(snapshot_dir, exist_ok=True)
+    if load_anchor_config(snapshot_dir) == config:
+        return
+    path = os.path.join(snapshot_dir, _ANCHOR_FILE)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(dataclasses.asdict(config), f, indent=1)
+    os.replace(tmp, path)
+
+
+def load_anchor_config(snapshot_dir: str | None) -> AnchorConfig | None:
+    if not snapshot_dir:
+        return None
+    path = os.path.join(snapshot_dir, _ANCHOR_FILE)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        raw = json.load(f)
+    return AnchorConfig(**{k: tuple(v) for k, v in raw.items()})
+
+
+def resolve_anchor_config(
+    args, snapshot_dir: str | None, fresh: bool = False
+) -> AnchorConfig:
+    """Combine CLI flags with the config persisted beside the checkpoint.
+
+    - flags given, no saved config (or they match): use the flags;
+    - no flags, saved config present: use the saved one (an eval/export/
+      resume run never has to repeat the flags);
+    - both present and DIFFERENT: abort — mixing anchors across a
+      checkpoint boundary decodes garbage, never do it silently.
+    - ``fresh`` (--no-resume): the run deliberately ignores prior state,
+      so the flags (or defaults) win and the stale sidecar is ignored
+      (the caller's save then overwrites it).
+    """
+    from_flags = make_anchor_config(args)
+    if fresh:
+        return from_flags
+    flags_given = any(
+        getattr(args, f"anchor_{k}") is not None for k in _FLAG_FIELDS
+    )
+    saved = load_anchor_config(snapshot_dir)
+    if saved is None:
+        return from_flags
+    if not flags_given:
+        if saved != AnchorConfig():
+            print(f"using anchor config persisted in {snapshot_dir}")
+        return saved
+    if from_flags != saved:
+        raise SystemExit(
+            f"anchor flags conflict with the config persisted in "
+            f"{snapshot_dir} (trained with {saved}); drop the flags to use "
+            "the saved config, or point --snapshot-path elsewhere"
+        )
+    return from_flags

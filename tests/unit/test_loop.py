@@ -127,3 +127,37 @@ class TestRunTraining:
         jax.tree.map(
             np.testing.assert_array_equal, s2.params, s_full.params
         )
+
+
+def test_non_finite_loss_aborts_with_step_number():
+    """SURVEY.md §5.2 numerical sanitizer: LR=inf poisons the params after
+    the first update; the loop must abort with the offending step instead
+    of training garbage."""
+    model = tiny_model()
+    state = create_train_state(
+        model, optax.sgd(float("inf")), (1, *HW, 3), jax.random.key(0)
+    )
+    with pytest.raises(FloatingPointError, match="before step 2"):
+        run_training(
+            model,
+            state,
+            batch_stream(),
+            NUM_CLASSES,
+            LoopConfig(total_steps=3, log_every=1),
+        )
+
+
+def test_debug_nans_flag_parses():
+    import os
+    import sys
+
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    from train import parse_args
+
+    args = parse_args(["synthetic", "--debug-nans"])
+    assert args.debug_nans is True
+    assert parse_args(["synthetic"]).debug_nans is False

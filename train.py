@@ -64,6 +64,15 @@ from batchai_retinanet_horovod_coco_tpu.models.retinanet import (  # noqa: E402
 )
 
 
+# Shared with convert_model.py / debug.py — one anchor surface (utils/cli.py).
+from batchai_retinanet_horovod_coco_tpu.utils.cli import (  # noqa: E402
+    add_anchor_flags,
+    make_anchor_config,
+    resolve_anchor_config,
+    save_anchor_config,
+)
+
+
 def build_parser() -> argparse.ArgumentParser:
     # allow_abbrev=False: preset-default resolution compares raw argv flag
     # names against dest names, which only works with unabbreviated flags.
@@ -133,6 +142,9 @@ def build_parser() -> argparse.ArgumentParser:
                             "faster on TPU (models/resnet.py)")
         g.add_argument("--f32", action="store_true",
                        help="compute in float32 (default bfloat16)")
+        # Anchor hyperparameters (keras-retinanet --config ini parity,
+        # SURVEY.md M5/M11): shared surface, utils/cli.py.
+        add_anchor_flags(g)
         g.add_argument("--freeze-backbone", action="store_true")
         g.add_argument("--pretrained-backbone", default=None,
                        help="torch resnet50 state dict (.pth/.npz) to import; "
@@ -185,6 +197,11 @@ def build_parser() -> argparse.ArgumentParser:
         g.add_argument("--tensorboard", action="store_true")
         g.add_argument("--profile-dir", default=None,
                        help="write a jax.profiler trace of a few steps here")
+        g.add_argument("--debug-nans", action="store_true",
+                       help="numerical sanitizer (SURVEY.md 5.2): enable "
+                            "jax_debug_nans so the originating op of a "
+                            "NaN/Inf is reported; the loop independently "
+                            "aborts on a non-finite loss either way")
         g.add_argument("--eval-only", action="store_true")
         g.add_argument("--score-threshold", type=float, default=0.05)
         g.add_argument("--nms-threshold", type=float, default=0.5)
@@ -312,6 +329,11 @@ def main(argv=None) -> dict[str, float]:
                 ).strip()
         jax.config.update("jax_platforms", args.platform)
 
+    if args.debug_nans:
+        # SURVEY.md §5.2 numerical sanitizer: every jit result is checked
+        # and the failing op re-run un-jitted for a precise report.
+        jax.config.update("jax_debug_nans", True)
+
     from batchai_retinanet_horovod_coco_tpu.data import (
         PipelineConfig,
         build_pipeline,
@@ -366,12 +388,20 @@ def main(argv=None) -> dict[str, float]:
             "no validation set: pass --val-csv-annotations to evaluate"
         )
 
+    # Flags + the config persisted beside the checkpoint (conflict = abort);
+    # persist on fresh training so eval/export/resume never need the flags.
+    anchor_config = resolve_anchor_config(
+        args, args.snapshot_path, fresh=args.no_resume
+    )
+    if args.snapshot_path and not args.eval_only and jax.process_index() == 0:
+        save_anchor_config(args.snapshot_path, anchor_config)
     model = build_retinanet(
         RetinaNetConfig(
             num_classes=num_classes,
             backbone=args.backbone,
             norm_kind=args.norm,
             stem=args.stem,
+            anchor=anchor_config,
             dtype=jnp.float32 if args.f32 else jnp.bfloat16,
         )
     )
@@ -460,21 +490,45 @@ def main(argv=None) -> dict[str, float]:
         score_threshold=args.score_threshold,
         iou_threshold=args.nms_threshold,
         max_detections=args.max_detections,
+        anchor=anchor_config,
     )
 
     def eval_fn(eval_state) -> dict[str, float]:
-        # Every process runs the full val set (identical results); only
-        # process 0 logs.  Detection itself is sharded over the mesh.
+        # Val work is SHARDED across processes: each host decodes its slice
+        # of the val set and detects on its LOCAL devices; the detections
+        # all-gather before scoring (evaluate/detect.py).  The reference ran
+        # the whole eval on rank 0 (SURVEY.md M10) — at pod scale that is
+        # hosts× redundant decode; here host work scales 1/process_count.
+        # Only process 0 logs the (identical, post-gather) metrics.
+        if shard_count > 1:
+            from batchai_retinanet_horovod_coco_tpu.parallel.mesh import (
+                make_local_mesh,
+            )
+
+            eval_mesh = make_local_mesh()
+            eval_batch = max(
+                len(jax.local_devices()),
+                args.batch_size // shard_count,
+            )
+            # The training state is replicated over the GLOBAL mesh; a
+            # local-mesh program cannot consume it directly.  Replicated →
+            # every shard is addressable → one host copy suffices.
+            eval_state = jax.device_get(eval_state)
+        else:
+            eval_mesh = mesh
+            eval_batch = args.batch_size
         val_batches = build_pipeline(
             val_ds,
             PipelineConfig(
-                batch_size=args.batch_size, shuffle=False, hflip_prob=0.0,
+                batch_size=eval_batch, shuffle=False, hflip_prob=0.0,
+                shard_index=shard_index, shard_count=shard_count,
                 **pipe_common,
             ),
             train=False,
         )
         return run_coco_eval(
-            eval_state, model, val_ds, val_batches, detect_config, mesh=mesh,
+            eval_state, model, val_ds, val_batches, detect_config,
+            mesh=eval_mesh,
             # CSV/Pascal datasets additionally report the reference's
             # Evaluate-callback metric (VOC AP@0.5 per class) from the same
             # detection pass.
@@ -491,7 +545,10 @@ def main(argv=None) -> dict[str, float]:
             )
 
             state = CheckpointManager(args.snapshot_path).restore(state)
-        if mesh is not None:
+        if mesh is not None and shard_count == 1:
+            # Multi-host skips this: restored arrays are committed to local
+            # devices (cross-host device_put is unsupported on some
+            # backends) and the sharded eval_fn pulls state to host anyway.
             from batchai_retinanet_horovod_coco_tpu.parallel.mesh import (
                 replicated_sharding,
             )
@@ -525,6 +582,7 @@ def main(argv=None) -> dict[str, float]:
         ),
         mesh=mesh,
         schedule=schedule,
+        anchor_config=anchor_config,
         shard_weight_update=shard_update,
         eval_fn=eval_fn
         if (args.eval_every or args.dataset_type in ("coco", "pascal")

@@ -15,8 +15,10 @@ TPU-first design:
   * ``"frozen_bn"``: running-stats-only BatchNorm (never updates), matching
     the reference's frozen-BN fine-tuning recipe when pretrained weights are
     supplied.
-- Strided 3x3 in the bottleneck's middle conv (v1.5), SAME padding so spatial
-  dims follow ceil(H/stride) — consistent with ops.anchors.feature_shape.
+- Strided 3x3 in the bottleneck's middle conv (v1.5), symmetric torch-style
+  padding (k//2 each side) so imported torchvision weights see the exact
+  sampling grid they were trained with; spatial dims still follow
+  ceil(H/stride) — consistent with ops.anchors.feature_shape.
 """
 
 from __future__ import annotations
@@ -78,17 +80,16 @@ class StemConv(nn.Module):
         )
         dn = ("NHWC", "HWIO", "NHWC")
         if not self.space_to_depth:
-            # SAME padding (the nn.Conv rule this replaces): out = ceil(d/2);
-            # (2, 3) for even dims, (3, 3) for odd.
-            def same_pad(d: int) -> tuple[int, int]:
-                total = max((-(-d // 2) - 1) * 2 + 7 - d, 0)
-                return total // 2, total - total // 2
-
+            # Symmetric (3, 3) padding — torchvision's conv1 geometry, so
+            # imported pretrained weights see the exact sampling grid they
+            # were trained with (XLA's SAME rule pads (2, 3) on even dims,
+            # shifting every output half a tap).  Output stays ceil(d/2)
+            # for every input parity.
             return lax.conv_general_dilated(
                 x,
                 kernel.astype(self.dtype),
                 window_strides=(2, 2),
-                padding=(same_pad(x.shape[1]), same_pad(x.shape[2])),
+                padding=((3, 3), (3, 3)),
                 dimension_numbers=dn,
             )
 
@@ -104,12 +105,13 @@ class StemConv(nn.Module):
         x = x.reshape(b, h // s, s, w // s, s, c_in)
         x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // s, w // s, s * s * c_in)
         if s == 2:
-            # Kernel: pad 7→8 taps (last tap zero), split each spatial dim
+            # Kernel: pad 7→8 taps (LEADING zero), split each spatial dim
             # into (block, within-block) and fold within-block into input
-            # channels in the SAME (p_h, p_w, c) order.  out[j] =
-            # Σ_r x[2j-2+r]·w[r] becomes a 4-tap block conv starting at
-            # block j-1 → padding (1, 2).
-            k = jnp.pad(kernel, ((0, 1), (0, 1), (0, 0), (0, 0)))
+            # channels in the SAME (p_h, p_w, c) order.  With the torch
+            # geometry out[j] = Σ_t x[2j+t-3]·w[t]; writing the x index as
+            # 2(j+β)+r gives tap u = 2β+r+4 into the zero-led 8-kernel —
+            # a 4-tap block conv over β ∈ {-2..1} → padding (2, 1).
+            k = jnp.pad(kernel, ((1, 0), (1, 0), (0, 0), (0, 0)))
             k = k.reshape(4, 2, 4, 2, c_in, self.features)
             k = k.transpose(0, 2, 1, 3, 4, 5).reshape(
                 4, 4, 4 * c_in, self.features
@@ -118,24 +120,25 @@ class StemConv(nn.Module):
                 x,
                 k.astype(self.dtype),
                 window_strides=(1, 1),
-                padding=((1, 2), (1, 2)),
+                padding=((2, 1), (2, 1)),
                 dimension_numbers=dn,
             )
         if s != 4:
             raise ValueError(f"space_to_depth block must be 2 or 4, got {s}")
         # 4x4 fold: each block carries TWO stride-2 outputs per spatial dim,
         # emitted as extra output channels and unfolded depth-to-space below.
-        # With SAME padding the stride-2 conv is out[i] = Σ_t w[t]·x[2i+t-2]
-        # (t = 0..6); writing i = 2j+u (u ∈ {0,1} within block j) and
-        # x-index = 4(j+β)+r (β block tap, r ∈ 0..3 within block) gives
-        #   t = 4β + r - 2u + 2,
+        # With the torch (3, 3) padding the stride-2 conv is
+        # out[i] = Σ_t w[t]·x[2i+t-3] (t = 0..6); writing i = 2j+u
+        # (u ∈ {0,1} within block j) and x-index = 4(j+β)+r (β block tap,
+        # r ∈ 0..3 within block) gives
+        #   t = 4β + r - 2u + 3,
         # a 3-tap block conv (β ∈ {-1,0,1}, padding (1,1)) whose folded
         # kernel gathers the original tap t where valid and zero elsewhere.
         beta = jnp.arange(3) - 1  # block taps
         r = jnp.arange(4)
         u = jnp.arange(2)
         t = (4 * beta[:, None, None] + r[None, :, None]
-             - 2 * u[None, None, :] + 2)  # (β, r, u)
+             - 2 * u[None, None, :] + 3)  # (β, r, u)
         valid = (t >= 0) & (t <= 6)
         t = jnp.where(valid, t, 7)  # 7 = the zero-padded tap
         kp = jnp.pad(kernel, ((0, 1), (0, 1), (0, 0), (0, 0)))  # (8,8,c,f)
@@ -196,11 +199,15 @@ class BottleneckBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
+        # Symmetric (k//2) padding, torchvision's geometry: identical to
+        # SAME for stride 1, but for stride 2 on even dims SAME pads (0, 1)
+        # — a one-pixel grid shift that would misalign imported pretrained
+        # features.  Output sizes are ceil(d/s) either way.
         conv = lambda f, k, s, name: nn.Conv(  # noqa: E731
             f,
             (k, k),
             strides=(s, s),
-            padding="SAME",
+            padding=((k // 2, k // 2), (k // 2, k // 2)),
             use_bias=False,
             dtype=self.dtype,
             param_dtype=jnp.float32,
@@ -244,7 +251,11 @@ class ResNet(nn.Module):
         )(x)
         x = norm("stem_norm", train)(x)
         x = nn.relu(x)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        # Symmetric (1, 1) padding (torch geometry; SAME would pad (0, 1)
+        # on even dims).  -inf pad so padding never wins the max.
+        x = nn.max_pool(
+            x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1))
+        )
 
         features: dict[str, jnp.ndarray] = {}
         filters = 64

@@ -44,7 +44,9 @@ class AnchorConfig:
     def feature_shape(self, image_hw: tuple[int, int], level: int) -> tuple[int, int]:
         """Feature-map shape at ``level`` for a padded image of ``image_hw``.
 
-        Matches conv stride arithmetic with SAME padding: ceil(dim / stride).
+        Matches the backbones' conv stride arithmetic — symmetric k//2
+        padding (torch geometry, models/resnet.py) — which, like SAME,
+        yields ceil(dim / stride) for every input parity.
         """
         stride = self.strides[self.levels.index(level)]
         return (

@@ -237,11 +237,15 @@ def run_training(
             # Numerical sanitizer (SURVEY.md §5.2): a non-finite loss aborts
             # with the offending step instead of silently training garbage.
             if "loss" in scalars and not np.isfinite(scalars["loss"]):
+                checked = (
+                    f"every {config.log_every} steps"
+                    if config.log_every
+                    else "only at the final step (log_every=0)"
+                )
                 raise FloatingPointError(
                     f"non-finite loss ({float(scalars['loss'])}) at or "
-                    f"before step {step} (loss is checked every "
-                    f"{config.log_every or 1} steps); rerun with "
-                    "--debug-nans to locate the originating op"
+                    f"before step {step} (loss is checked {checked}); rerun "
+                    "with --debug-nans to locate the originating op"
                 )
             dt = time.perf_counter() - window_t0
             scalars["images_per_sec"] = window_images / max(dt, 1e-9)

@@ -36,11 +36,11 @@ from batchai_retinanet_horovod_coco_tpu.train import create_train_state, make_tr
 
 HW = (64, 64)
 GOLDEN_LOSSES = (
-    5.7867107391,
-    5.7674546242,
-    5.7321596146,
-    5.6434984207,
-    5.3189058304,
+    5.7837281227,
+    5.7642784119,
+    5.7254600525,
+    5.6187024117,
+    5.1890058517,
 )
 
 

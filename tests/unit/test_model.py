@@ -185,7 +185,7 @@ class TestSpaceToDepthStem:
             StemConv(space_to_depth=True).init(jax.random.key(0), x)
 
     def test_plain_stem_same_padding_odd_dims(self):
-        """conv mode keeps nn.Conv's SAME rule: out = ceil(d/2), odd dims too."""
+        """conv mode keeps out = ceil(d/2) under torch (3,3) padding, odd dims too."""
         from batchai_retinanet_horovod_coco_tpu.models.resnet import StemConv
 
         x = jnp.zeros((1, 33, 47, 3), jnp.float32)

@@ -512,8 +512,17 @@ def main(argv=None) -> dict[str, float]:
             )
             # The training state is replicated over the GLOBAL mesh; a
             # local-mesh program cannot consume it directly.  Replicated →
-            # every shard is addressable → one host copy suffices.
-            eval_state = jax.device_get(eval_state)
+            # every shard is addressable → one host copy suffices; re-upload
+            # it ONCE onto the local mesh (process-local put) so the detect
+            # fn is not fed numpy — that would re-transfer ~450 MB of
+            # params+optimizer state per eval batch.
+            from batchai_retinanet_horovod_coco_tpu.parallel.mesh import (
+                replicated_sharding,
+            )
+
+            eval_state = jax.device_put(
+                jax.device_get(eval_state), replicated_sharding(eval_mesh)
+            )
         else:
             eval_mesh = mesh
             eval_batch = args.batch_size

@@ -116,6 +116,7 @@ def run_training(
     eval_fn: Callable[[TrainState], dict[str, float]] | None = None,
     logger: MetricLogger | None = None,
     shard_weight_update: bool = False,
+    quantized_allreduce: bool = False,
 ) -> TrainState:
     """Run ``config.total_steps`` of SPMD training; returns the final state.
 
@@ -215,6 +216,7 @@ def run_training(
                 matching_config=matching_config,
                 anchor_config=anchor_config,
                 shard_weight_update=shard_weight_update,
+                quantized_allreduce=quantized_allreduce,
             )
         if config.profile_dir and step == prof_start:
             jax.profiler.start_trace(config.profile_dir)

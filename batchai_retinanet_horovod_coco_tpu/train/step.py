@@ -96,6 +96,7 @@ def make_train_step(
     anchor_config: anchors_lib.AnchorConfig | None = None,
     donate_state: bool = True,
     shard_weight_update: bool = False,
+    quantized_allreduce: bool = False,
 ) -> Callable[[TrainState, dict[str, Any]], tuple[TrainState, dict[str, jnp.ndarray]]]:
     """Build the jitted train step for one shape bucket.
 
@@ -114,12 +115,26 @@ def make_train_step(
     ``make_optimizer(..., shard_clip_axis=DATA_AXIS)`` so gradient clipping
     uses the global (cross-shard) norm.
 
+    ``quantized_allreduce`` (requires ``mesh``, exclusive with
+    ``shard_weight_update``): the gradient all-reduce compresses its gather
+    phase to int8 (parallel/quantize.py) — ~5/8 the ICI traffic of the f32
+    all-reduce, error bounded by one rounding of the already-reduced
+    gradient.  SURVEY.md §5.8's optional EQuARX-style optimization.
+
     The returned callable takes (state, batch_dict) where batch_dict holds
     ``images, gt_boxes, gt_labels, gt_mask`` (leading axis = GLOBAL batch)
     and returns (new_state, metrics).
     """
     if shard_weight_update and mesh is None:
         raise ValueError("shard_weight_update requires a mesh")
+    if quantized_allreduce and mesh is None:
+        raise ValueError("quantized_allreduce requires a mesh")
+    if quantized_allreduce and shard_weight_update:
+        raise ValueError(
+            "quantized_allreduce and shard_weight_update are exclusive "
+            "(ZeRO already reduce-scatters; its gather carries params, "
+            "whose quantization would bias the model, not a gradient)"
+        )
     anchors = jnp.asarray(
         anchors_lib.anchors_for_image_shape(image_hw, anchor_config or anchors_lib.AnchorConfig())
     )
@@ -236,8 +251,14 @@ def make_train_step(
     )
     def sharded_step(state: TrainState, batch: dict[str, Any]):
         grads, metrics, new_bs = local_step(state, batch)
-        # THE allreduce: Horovod's NCCL ring → one compiled pmean over ICI.
-        grads = lax.pmean(grads, DATA_AXIS)
+        # THE allreduce: Horovod's NCCL ring → one compiled pmean over ICI
+        # (optionally with an int8-compressed gather phase).
+        if quantized_allreduce:
+            from batchai_retinanet_horovod_coco_tpu.parallel import quantize
+
+            grads = quantize.quantized_pmean(grads, DATA_AXIS, mesh.size)
+        else:
+            grads = lax.pmean(grads, DATA_AXIS)
         num_pos = lax.psum(metrics["num_pos"], DATA_AXIS)  # a count, not a mean
         metrics = lax.pmean(metrics, DATA_AXIS)
         metrics["num_pos"] = num_pos

@@ -41,7 +41,13 @@ def _quantized_pmean_flat(flat: jnp.ndarray, axis_name: str, n: int) -> jnp.ndar
     shard = lax.psum_scatter(flat, axis_name, tiled=True) / n
     # Phase 2: symmetric int8 with a per-shard scale (gathered alongside).
     amax = jnp.max(jnp.abs(shard))
-    scale = jnp.maximum(amax, 1e-30) / 127.0
+    # A non-finite gradient must SURFACE (the loop's non-finite-loss abort,
+    # SURVEY §5.2) — int8 casting would launder Inf/NaN into finite garbage,
+    # so poison the gathered scale instead: the whole dequantized shard goes
+    # NaN and the divergence aborts exactly like the exact-pmean path.
+    scale = jnp.where(
+        jnp.isfinite(amax), jnp.maximum(amax, 1e-30) / 127.0, jnp.nan
+    )
     q = jnp.clip(jnp.round(shard / scale), -127.0, 127.0).astype(jnp.int8)
     q_all = lax.all_gather(q, axis_name)  # (n, padded // n) int8
     s_all = lax.all_gather(scale, axis_name)  # (n,) f32

@@ -112,3 +112,14 @@ def test_train_step_learns_with_quantization():
     # trajectories stay close — int8 on reduced grads is a tiny perturbation.
     np.testing.assert_allclose(q_losses[0], e_losses[0], rtol=1e-6)
     np.testing.assert_allclose(q_losses[-1], e_losses[-1], rtol=0.1)
+
+
+def test_non_finite_gradients_surface_as_nan():
+    """Inf/NaN grads must NOT be laundered into finite int8 garbage — the
+    dequantized result goes NaN so the loop's non-finite-loss abort fires
+    exactly as it would on the exact-pmean path."""
+    rng = np.random.default_rng(2)
+    big = rng.normal(0, 0.1, (N, 16, 1024)).astype(np.float32)
+    big[3, 5, 100] = np.inf
+    q, _ = _run_both({"w": jnp.asarray(big)})
+    assert not np.isfinite(np.asarray(q["w"])).all()

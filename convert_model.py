@@ -36,7 +36,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--backbone", default="resnet50", choices=BACKBONES)
     p.add_argument("--norm", default="gn", choices=["gn", "bn", "frozen_bn"])
     p.add_argument("--stem", default="space_to_depth",
-                   choices=["conv", "space_to_depth"],
+                   choices=["conv", "space_to_depth", "space_to_depth4"],
                    help="stem formulation (param layout is identical; "
                         "either loads any snapshot)")
     p.add_argument("--f32", action="store_true",

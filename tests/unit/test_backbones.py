@@ -83,3 +83,29 @@ def test_retinanet_assembly_and_grad(backbone):
         jnp.sqrt(sum(jnp.sum(t**2) for t in jax.tree.leaves(g)))
     )
     assert np.isfinite(norm) and norm > 0
+
+
+from batchai_retinanet_horovod_coco_tpu.models.retinanet import BACKBONES
+
+
+@pytest.mark.parametrize("backbone_name", BACKBONES)
+def test_every_registered_backbone_builds(backbone_name):
+    """Registry contract for ALL entries (incl. resnet101/152, densenet201,
+    which no other test touches): the assembled RetinaNet must produce
+    cls/box outputs over exactly the anchor count the anchor machinery
+    derives for the input shape.  eval_shape only — no weights, no device
+    compute — a few seconds of host tracing per deep variant."""
+    from batchai_retinanet_horovod_coco_tpu.ops.anchors import AnchorConfig
+
+    a_total = AnchorConfig().num_anchors(HW)
+    model = build_retinanet(
+        RetinaNetConfig(
+            num_classes=3, backbone=backbone_name, fpn_channels=16,
+            head_width=16, head_depth=1, dtype=jnp.float32,
+        )
+    )
+    x = jnp.zeros((1, *HW, 3), jnp.float32)
+    variables = jax.eval_shape(model.init, jax.random.key(0), x)
+    out = jax.eval_shape(lambda v: model.apply(v, x, train=False), variables)
+    assert out["cls_logits"].shape == (1, a_total, 3)
+    assert out["box_deltas"].shape == (1, a_total, 4)

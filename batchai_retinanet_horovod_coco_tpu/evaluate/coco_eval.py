@@ -154,6 +154,15 @@ class CocoEval:
         params: EvalParams | None = None,
     ):
         self.params = params or EvalParams()
+        # ``_prepare`` caches per (img, cat) with dets truncated at
+        # max_dets[-1]; ``accumulate`` then re-slices ``[:max_det]`` per M
+        # entry.  Both steps (like pycocotools itself) are only correct when
+        # max_dets is ascending — reject the silent-wrong-scores case.
+        if list(self.params.max_dets) != sorted(self.params.max_dets):
+            raise ValueError(
+                f"EvalParams.max_dets must be ascending, got "
+                f"{list(self.params.max_dets)}"
+            )
         if img_ids is None:
             img_ids = sorted(
                 {a["image_id"] for a in gt_anns} | {a["image_id"] for a in dt_anns}

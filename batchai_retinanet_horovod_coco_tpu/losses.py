@@ -68,6 +68,29 @@ class LossConfig:
     pallas_interpret: bool = False
 
 
+def _focal_elementwise(
+    logits: jnp.ndarray, targets: jnp.ndarray, config: LossConfig
+) -> jnp.ndarray:
+    """Per-element focal terms (same shape as ``logits``); f32 in/out.
+
+    Exponential form — 2 transcendentals/element instead of ~5.  With
+    sp_neg = softplus(-x) = -log p and sp_neg + x*t ∈ {sp_neg, softplus(x)}:
+      bce        = -log p_t       = softplus(x) - x*t  (= sp_neg + x - x*t)
+      (1-p_t)^γ  = exp(γ log(1-p_t)) = exp(-γ (sp_neg + x*t))
+    Both factors come from ONE softplus and ONE exp; the VPU-bound focal
+    op is transcendental-limited, so this halves its step cost (measured
+    ~6.2ms → see ops/pallas/focal.py for the numbers at the flagship bucket).
+    """
+    sp_neg = nn.softplus(-logits)
+    xt = logits * targets
+    bce = sp_neg + logits - xt  # == softplus(x) - x*t, stable for any x
+    modulator = jnp.exp(-config.focal_gamma * (sp_neg + xt))
+    alpha_t = config.focal_alpha * targets + (1.0 - config.focal_alpha) * (
+        1.0 - targets
+    )
+    return alpha_t * modulator * bce
+
+
 def focal_sums(
     cls_logits: jnp.ndarray,
     cls_targets: jnp.ndarray,
@@ -82,22 +105,7 @@ def focal_sums(
     """
     logits = cls_logits.astype(jnp.float32)
     targets = cls_targets.astype(jnp.float32)
-
-    # Exponential form — 2 transcendentals/element instead of ~5.  With
-    # sp_neg = softplus(-x) = -log p and sp_neg + x*t ∈ {sp_neg, softplus(x)}:
-    #   bce        = -log p_t       = softplus(x) - x*t  (= sp_neg + x - x*t)
-    #   (1-p_t)^γ  = exp(γ log(1-p_t)) = exp(-γ (sp_neg + x*t))
-    # Both factors come from ONE softplus and ONE exp; the VPU-bound focal
-    # op is transcendental-limited, so this halves its step cost (measured
-    # ~6.2ms → see ops/pallas/focal.py for the numbers at the flagship bucket).
-    sp_neg = nn.softplus(-logits)
-    xt = logits * targets
-    bce = sp_neg + logits - xt  # == softplus(x) - x*t, stable for any x
-    modulator = jnp.exp(-config.focal_gamma * (sp_neg + xt))
-    alpha_t = config.focal_alpha * targets + (1.0 - config.focal_alpha) * (
-        1.0 - targets
-    )
-    loss = alpha_t * modulator * bce  # (..., A, K)
+    loss = _focal_elementwise(logits, targets, config)  # (..., A, K)
 
     not_ignored = (anchor_state != matching.IGNORE).astype(jnp.float32)
     loss = loss * not_ignored[..., None]
@@ -190,6 +198,16 @@ def focal_sums_compact(
     return focal_sums(cls_logits, targets, anchor_state, config)
 
 
+def _smooth_l1_elementwise(
+    preds: jnp.ndarray, targets: jnp.ndarray, config: LossConfig
+) -> jnp.ndarray:
+    """Per-element smooth-L1 terms (f32 in/out) — the single definition
+    shared by the anchor-major and NHWC paths."""
+    diff = jnp.abs(preds - targets)
+    beta = config.smooth_l1_beta
+    return jnp.where(diff < beta, 0.5 * diff * diff / beta, diff - 0.5 * beta)
+
+
 def smooth_l1_sums(
     box_preds: jnp.ndarray,
     box_targets: jnp.ndarray,
@@ -197,12 +215,9 @@ def smooth_l1_sums(
     config: LossConfig = LossConfig(),
 ) -> jnp.ndarray:
     """Per-image smooth-L1 sums (...,) over positive anchors — no normalizer."""
-    preds = box_preds.astype(jnp.float32)
-    targets = box_targets.astype(jnp.float32)
-    diff = jnp.abs(preds - targets)
-    beta = config.smooth_l1_beta
-    loss = jnp.where(diff < beta, 0.5 * diff * diff / beta, diff - 0.5 * beta)
-
+    loss = _smooth_l1_elementwise(
+        box_preds.astype(jnp.float32), box_targets.astype(jnp.float32), config
+    )
     positive = (anchor_state == matching.POSITIVE).astype(jnp.float32)
     loss = loss * positive[..., None]
     return jnp.sum(loss, axis=(-2, -1))
@@ -277,6 +292,107 @@ def total_loss_compact_levels(
         )
         box_sum = box_sum + smooth_l1_sums(
             box_l, box_targets[..., sl, :], anchor_state[..., sl], config
+        )
+    cls = _normalize_per_image(cls_sum, anchor_state)
+    box = _normalize_per_image(box_sum, anchor_state)
+    return {
+        "loss": cls + config.box_loss_weight * box,
+        "cls_loss": cls,
+        "box_loss": box,
+    }
+
+
+def total_loss_compact_nhwc(
+    cls_levels: tuple[jnp.ndarray, ...],
+    box_levels: tuple[jnp.ndarray, ...],
+    matched_labels: jnp.ndarray,
+    box_targets: jnp.ndarray,
+    anchor_state: jnp.ndarray,
+    anchors_per_location: int,
+    config: LossConfig = LossConfig(),
+) -> dict[str, jnp.ndarray]:
+    """:func:`total_loss_compact` on RAW (B, h, w, A·K) head outputs.
+
+    The anchor-major path retiles every level's lane dimension
+    (A·K → K-minor), concatenates, and splits again in the backward pass —
+    ~4 ms of pure layout traffic at the flagship bucket (round-3 profile:
+    reshape.419/483 + concatenate.7 + split.1).  Here the big tensors stay
+    in their conv-native layout end-to-end: the per-level target slices are
+    the only retiled arrays ((B, A_l) int32/int8 — a few MB), and the view
+    reshapes on the head outputs feed straight into the fused elementwise
+    focal/smooth-L1 + reduction, so XLA never materializes them.  Equals
+    :func:`total_loss_compact` on the concatenated outputs up to f32
+    summation order (pinned by a unit test).
+    """
+    if config.pallas_focal:
+        raise ValueError(
+            "pallas_focal is not routed through the NHWC path; use "
+            "total_loss_compact (concatenated) with it"
+        )
+    a_loc = anchors_per_location
+    covered = sum(c.shape[1] * c.shape[2] * a_loc for c in cls_levels)
+    if covered != anchor_state.shape[-1]:
+        raise ValueError(
+            f"level outputs cover {covered} anchors, targets have "
+            f"{anchor_state.shape[-1]}"
+        )
+    batch_shape = anchor_state.shape[:-1]
+    cls_sum = jnp.zeros(batch_shape, jnp.float32)
+    box_sum = jnp.zeros(batch_shape, jnp.float32)
+    offset = 0
+    for cls_l, box_l in zip(cls_levels, box_levels, strict=True):
+        b, h, w, ck = cls_l.shape
+        k = ck // a_loc
+        n = h * w * a_loc
+        sl = slice(offset, offset + n)
+        offset += n
+        # Per-level targets, reshaped on the SMALL side only ((B, A_l)
+        # ints and the (B, A_l, 4) box targets — a few MB).  The big head
+        # tensors are never split into (A, K)/(A, 4) views: a 4-minor-dim
+        # view of a (B, h, w, 36) tensor retiles it catastrophically
+        # (measured: the first nhwc attempt moved ~7 ms of retile cost
+        # INTO the loss).  Instead the masks/targets broadcast-reshape
+        # from (B, h, w, A) up to the A·K channel layout — index
+        # arithmetic inside the fusion, no materialization.
+        labels4 = matched_labels[..., sl].reshape(*batch_shape, h, w, a_loc)
+        state4 = anchor_state[..., sl].reshape(*batch_shape, h, w, a_loc)
+        positive4 = state4 == matching.POSITIVE
+
+        # Masks stay BOOL through any materialization XLA decides on (the
+        # broadcast-reshapes below are not bitcasts, so they can land in
+        # HBM) — as f32 they measured ~4x the copy traffic.  The focal
+        # arithmetic consumes the bool target via where-forms.
+        t_ck = (
+            positive4[..., None]
+            & (labels4[..., None] == jnp.arange(k, dtype=jnp.int32))
+        ).reshape(*batch_shape, h, w, ck)  # (B, h, w, A*K) bool
+        logits = cls_l.astype(jnp.float32)
+        sp_neg = nn.softplus(-logits)
+        xt = jnp.where(t_ck, logits, 0.0)
+        bce = sp_neg + logits - xt
+        modulator = jnp.exp(-config.focal_gamma * (sp_neg + xt))
+        alpha_t = jnp.where(t_ck, config.focal_alpha, 1.0 - config.focal_alpha)
+        fl = alpha_t * modulator * bce
+        ni_ck = jnp.broadcast_to(
+            (state4 != matching.IGNORE)[..., None],
+            (*batch_shape, h, w, a_loc, k),
+        ).reshape(*batch_shape, h, w, ck)
+        cls_sum = cls_sum + jnp.sum(
+            jnp.where(ni_ck, fl, 0.0), axis=(-3, -2, -1)
+        )
+
+        c4 = a_loc * 4
+        boxt_ck = (
+            box_targets[..., sl, :]
+            .reshape(*batch_shape, h, w, c4)
+            .astype(jnp.float32)
+        )
+        sl1 = _smooth_l1_elementwise(box_l.astype(jnp.float32), boxt_ck, config)
+        pos_ck = jnp.broadcast_to(
+            positive4[..., None], (*batch_shape, h, w, a_loc, 4)
+        ).reshape(*batch_shape, h, w, c4)
+        box_sum = box_sum + jnp.sum(
+            jnp.where(pos_ck, sl1, 0.0), axis=(-3, -2, -1)
         )
     cls = _normalize_per_image(cls_sum, anchor_state)
     box = _normalize_per_image(box_sum, anchor_state)

@@ -6,10 +6,16 @@ backbones, the last of the reference's era backbone families, SURVEY.md M2).
 Rebuilt in flax: BC variant (1x1 bottleneck to 4·growth before every 3x3,
 transitions with 0.5 compression), growth rate 32.
 
-Feature taps follow the torchvision/keras convention for detection: each
-dense block's concatenated output (after the shared norm+relu) BEFORE the
-transition that downsamples for the next block — block2 @ stride 8 (c3),
-block3 @ stride 16 (c4), block4 + final norm @ stride 32 (c5).
+Feature taps: each dense block's concatenated output BEFORE the transition
+that downsamples for the next block — block2 @ stride 8 (c3), block3 @
+stride 16 (c4), block4 + final norm @ stride 32 (c5).  Documented
+divergence: the C3/C4 taps here come AFTER a shared block-out norm+relu
+(the transition's norm is hoisted before the tap so both consumers share
+it), whereas keras-applications taps the raw ``convN_blockM_concat``
+output and normalizes inside the transition.  Equivalent for from-scratch
+training (one extra norm+relu on the FPN lateral input); if a pretrained
+DenseNet import path is ever added, the tap must move before the
+``blockN_out_norm`` to match upstream activations exactly.
 
 TPU note: dense connectivity concatenates along channels, so the 3x3 convs
 contract over ever-wider inputs (MXU-friendly) but every block re-reads the

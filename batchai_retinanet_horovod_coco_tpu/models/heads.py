@@ -42,8 +42,14 @@ class ClassificationHead(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        """(B, H, W, C) → (B, H*W*anchors, num_classes) logits."""
+    def __call__(self, x: jnp.ndarray, flatten: bool = True) -> jnp.ndarray:
+        """(B, H, W, C) → (B, H*W*anchors, num_classes) logits.
+
+        ``flatten=False`` returns the raw (B, H, W, anchors*num_classes)
+        conv output: the anchor-major flatten retiles the lane dimension
+        (720 → K-minor), a real layout copy per level; the NHWC-direct loss
+        path (losses.total_loss_compact_nhwc) skips it.
+        """
         for i in range(self.depth):
             x = _head_conv(self.width, f"conv{i}", self.dtype)(x)
             x = nn.relu(x)
@@ -54,6 +60,8 @@ class ClassificationHead(nn.Module):
             self.dtype,
             bias_init=nn.initializers.constant(bias),
         )(x)
+        if not flatten:
+            return x
         b, h, w, _ = x.shape
         return x.reshape(b, h * w * self.anchors_per_location, self.num_classes)
 
@@ -65,11 +73,14 @@ class BoxHead(nn.Module):
     dtype: jnp.dtype = jnp.bfloat16
 
     @nn.compact
-    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
-        """(B, H, W, C) → (B, H*W*anchors, 4) deltas."""
+    def __call__(self, x: jnp.ndarray, flatten: bool = True) -> jnp.ndarray:
+        """(B, H, W, C) → (B, H*W*anchors, 4) deltas (see ClassificationHead
+        for ``flatten=False``)."""
         for i in range(self.depth):
             x = _head_conv(self.width, f"conv{i}", self.dtype)(x)
             x = nn.relu(x)
         x = _head_conv(4 * self.anchors_per_location, "deltas", self.dtype)(x)
+        if not flatten:
+            return x
         b, h, w, _ = x.shape
         return x.reshape(b, h * w * self.anchors_per_location, 4)

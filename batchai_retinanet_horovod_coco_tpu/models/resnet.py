@@ -41,8 +41,8 @@ class StemConv(nn.Module):
     ~180 for the heads' 256-channel convs).  ``space_to_depth`` is the
     MLPerf-ResNet reformulation: fold each 2x2 pixel block into channels
     (3 → 12) and convolve 4x4/1 with an exactly-equivalent reshaped kernel —
-    identical math, 4x the contraction depth, no layout copies of the
-    (B, H, W, 3) tensor.
+    identical math, 4x the contraction depth, one H-fold transpose of the
+    (B, H, W, 3) tensor (the W fold is layout-free; see the fold comment).
 
     The parameter keeps the canonical ``(7, 7, C, 64)`` layout either way, so
     checkpoints and the torch-weight importer (models/import_weights.py) are
@@ -99,21 +99,27 @@ class StemConv(nn.Module):
                 f"space_to_depth({self.block}) stem needs H, W divisible by "
                 f"{self.block}; got {(h, w)}"
             )
-        # Input: fold block x block pixel tiles into channels, (p_h, p_w, c)
-        # order.
+        # Input: fold block x block pixel tiles into channels.  Channel order
+        # is (p_w, p_h, c) — W-slot MAJOR — because that order makes the W
+        # fold a FREE reshape: only the H fold needs a real transpose.  The
+        # naive (p_h, p_w, c) reshape/transpose/reshape lowered to ~3.7 ms of
+        # minor-dim layout copies per b8 step (HLO copy.245/246/248, round-3
+        # profile); a strided-slice+concat form measured worse still
+        # (138.4 vs 131.8 ms/step).  Kernel folds below use the same order.
         s = self.block
-        x = x.reshape(b, h // s, s, w // s, s, c_in)
-        x = x.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // s, w // s, s * s * c_in)
+        x = x.reshape(b, h // s, s, w, c_in)
+        x = x.transpose(0, 1, 3, 2, 4)  # the one real data movement
+        x = x.reshape(b, h // s, w // s, s * s * c_in)  # W fold: free
         if s == 2:
             # Kernel: pad 7→8 taps (LEADING zero), split each spatial dim
             # into (block, within-block) and fold within-block into input
-            # channels in the SAME (p_h, p_w, c) order.  With the torch
-            # geometry out[j] = Σ_t x[2j+t-3]·w[t]; writing the x index as
-            # 2(j+β)+r gives tap u = 2β+r+4 into the zero-led 8-kernel —
-            # a 4-tap block conv over β ∈ {-2..1} → padding (2, 1).
+            # channels in the SAME (p_w, p_h, c) order as the input fold.
+            # With the torch geometry out[j] = Σ_t x[2j+t-3]·w[t]; writing
+            # the x index as 2(j+β)+r gives tap u = 2β+r+4 into the zero-led
+            # 8-kernel — a 4-tap block conv over β ∈ {-2..1} → padding (2, 1).
             k = jnp.pad(kernel, ((1, 0), (1, 0), (0, 0), (0, 0)))
             k = k.reshape(4, 2, 4, 2, c_in, self.features)
-            k = k.transpose(0, 2, 1, 3, 4, 5).reshape(
+            k = k.transpose(0, 2, 3, 1, 4, 5).reshape(
                 4, 4, 4 * c_in, self.features
             )
             return lax.conv_general_dilated(
@@ -143,10 +149,10 @@ class StemConv(nn.Module):
         t = jnp.where(valid, t, 7)  # 7 = the zero-padded tap
         kp = jnp.pad(kernel, ((0, 1), (0, 1), (0, 0), (0, 0)))  # (8,8,c,f)
         # Gather → (βh, rh, uh, βw, rw, uw, c, f), then order in-channels as
-        # (rh, rw, c) [matching the input fold] and out-channels as
+        # (rw, rh, c) [matching the input fold] and out-channels as
         # (uh, uw, f) [matching the depth-to-space unfold].
         k = kp[t[:, :, :, None, None, None], t[None, None, None, :, :, :]]
-        k = k.transpose(0, 3, 1, 4, 6, 2, 5, 7).reshape(
+        k = k.transpose(0, 3, 4, 1, 6, 2, 5, 7).reshape(
             3, 3, 16 * c_in, 4 * self.features
         )
         y = lax.conv_general_dilated(
@@ -163,6 +169,188 @@ class StemConv(nn.Module):
             b, 2 * bh, 2 * bw, self.features
         )
         return y
+
+
+# --- Width-packing: run narrow-channel stages with W-pairs folded into
+# channels ------------------------------------------------------------------
+#
+# Stage2's C=64 contractions under-fill the v5e MXU's 128 lanes on BOTH
+# matmul sides (profiled ~30 TFLOP/s vs ~188 for the 256-channel heads —
+# PARITY.md attribution table; the single worst slice of the step at
+# ~23 ms).  Folding each pair of adjacent W positions into channels makes
+# every stage2 tensor 128-channel and every conv a 128x128-block
+# contraction: kernels become block-structured (1x1 -> block-diagonal over
+# the two W slots; 3x3 -> a 3-tap conv over packed columns whose taps
+# gather the original taps, half the blocks structurally zero).  The
+# hardware does 2x the MACs (the zero blocks) at ~4x the lane occupancy.
+# MEASURED NEGATIVE end-to-end on v5e at the flagship bucket (58.3 vs
+# 60.7 imgs/s, b8): profiling shows stage2 is mostly HBM-bandwidth-bound
+# (~513 GB/s on 11.9 GB/step), so the extra MACs outweigh the occupancy
+# win; only its three fwd 3x3 convs (~2.5 ms at 48 TF/s) are lane-bound.
+# Kept OFF by default as an exact, tested reformulation (PARITY.md r3).
+# Math is IDENTICAL: same sums, reordered; params keep their canonical
+# shapes, so checkpoints/imports are layout-independent.
+#
+# Packed channel order is (c, u) — logical channel MAJOR, w-slot minor — so
+# GroupNorm's contiguous channel groups stay contiguous after packing and
+# per-channel affines broadcast with a plain reshape.
+
+
+def _pack_w(x: jnp.ndarray) -> jnp.ndarray:
+    """(B, H, W, C) → (B, H, W/2, 2C), packed channel index = c*2 + u."""
+    b, h, w, c = x.shape
+    return (
+        x.reshape(b, h, w // 2, 2, c)
+        .transpose(0, 1, 2, 4, 3)
+        .reshape(b, h, w // 2, 2 * c)
+    )
+
+
+def _unpack_w(x: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of :func:`_pack_w`."""
+    b, h, wp, c2 = x.shape
+    c = c2 // 2
+    return (
+        x.reshape(b, h, wp, c, 2).transpose(0, 1, 2, 4, 3).reshape(b, h, 2 * wp, c)
+    )
+
+
+def _pack_kernel_1x1(k: jnp.ndarray) -> jnp.ndarray:
+    """(1, 1, ci, co) → (1, 1, 2ci, 2co) block-diagonal over the w slot."""
+    cin, cout = k.shape[2], k.shape[3]
+    eye = jnp.eye(2, dtype=k.dtype)
+    kp = k[:, :, :, None, :, None] * eye[None, None, None, :, None, :]
+    return kp.reshape(1, 1, 2 * cin, 2 * cout)
+
+
+def _pack_kernel_3x3(k: jnp.ndarray) -> jnp.ndarray:
+    """(3, 3, ci, co) → (3, 3, 2ci, 2co) packed-column taps.
+
+    Output w index 2j+u reads input 2j+u+dw = 2(j+β)+r, so packed tap β
+    carries original tap dw = 2β + r - u where that lands in {-1, 0, 1}
+    and zero elsewhere (gathered via a zero-padded 4th tap).
+    """
+    cin, cout = k.shape[2], k.shape[3]
+    beta = jnp.arange(3) - 1
+    r = jnp.arange(2)
+    u = jnp.arange(2)
+    t = 2 * beta[:, None, None] + r[None, :, None] - u[None, None, :] + 1
+    tw = jnp.where((t >= 0) & (t <= 2), t, 3)  # (β, r, u); 3 = zero tap
+    kpad = jnp.pad(k, ((0, 0), (0, 1), (0, 0), (0, 0)))  # (3, 4, ci, co)
+    kp = kpad[:, tw]  # (dh, β, r, u, ci, co)
+    kp = kp.transpose(0, 1, 4, 2, 5, 3)  # (dh, β, ci, r, co, u)
+    return kp.reshape(3, 3, 2 * cin, 2 * cout)
+
+
+class PackedConv(nn.Module):
+    """Stride-1 conv on the width-packed layout; canonical param shape.
+
+    Declares ``kernel`` as the logical (k, k, cin, cout) — identical tree
+    to ``nn.Conv`` — and runs the packed-block equivalent; the kernel
+    repack is a few-KB gather XLA folds into weight preprocessing.
+    """
+
+    features: int
+    kernel_size: int  # 1 or 3
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cin = x.shape[-1] // 2
+        k = self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (self.kernel_size, self.kernel_size, cin, self.features),
+            jnp.float32,
+        )
+        if self.kernel_size == 1:
+            kp, pad = _pack_kernel_1x1(k), (0, 0)
+        elif self.kernel_size == 3:
+            kp, pad = _pack_kernel_3x3(k), (1, 1)
+        else:
+            raise ValueError(f"PackedConv supports k in (1, 3), got {self.kernel_size}")
+        return lax.conv_general_dilated(
+            x,
+            kp.astype(self.dtype),
+            window_strides=(1, 1),
+            padding=(pad, pad),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+
+
+class PackedGroupNorm(nn.Module):
+    """GroupNorm(32) on the packed layout, exact w.r.t. the unpacked op.
+
+    Stats for a logical-channel group must pool BOTH w slots of its
+    channels; the (c, u) packing keeps those contiguous, so this is the
+    plain group reshape with the slot axis folded into the group.
+    Params are the logical (C,) scale/bias — same tree as ``nn.GroupNorm``.
+    """
+
+    num_groups: int = 32
+    epsilon: float = 1e-6
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        b, h, wp, c2 = x.shape
+        c = c2 // 2
+        scale = self.param("scale", nn.initializers.ones_init(), (c,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros_init(), (c,), jnp.float32)
+        g = self.num_groups
+        xf = x.astype(jnp.float32).reshape(b, h, wp, g, c // g, 2)
+        mean = xf.mean(axis=(1, 2, 4, 5), keepdims=True)
+        # use_fast_variance formula, as flax GroupNorm computes it.
+        var = (xf * xf).mean(axis=(1, 2, 4, 5), keepdims=True) - mean * mean
+        y = (xf - mean) * lax.rsqrt(var + self.epsilon)
+        y = y * scale.reshape(1, 1, 1, g, c // g, 1) + bias.reshape(
+            1, 1, 1, g, c // g, 1
+        )
+        return y.reshape(b, h, wp, c2).astype(self.dtype)
+
+
+class PackedBatchNorm(nn.Module):
+    """BatchNorm on the packed layout; same variable tree as ``nn.BatchNorm``.
+
+    Batch statistics pool over (B, H, Wp, slot) — exactly the unpacked
+    (B, H, W) reduction.  ``use_running_average`` covers both frozen_bn
+    (always) and plain bn at eval; train-mode bn updates the running stats
+    with the same 0.9 momentum as the unpacked layer.
+    """
+
+    use_running_average: bool
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        b, h, wp, c2 = x.shape
+        c = c2 // 2
+        scale = self.param("scale", nn.initializers.ones_init(), (c,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros_init(), (c,), jnp.float32)
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((c,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((c,), jnp.float32)
+        )
+        xf = x.astype(jnp.float32).reshape(b, h, wp, c, 2)
+        if self.use_running_average:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            mean = xf.mean(axis=(0, 1, 2, 4))
+            var = (xf * xf).mean(axis=(0, 1, 2, 4)) - mean * mean
+            if not self.is_initializing():
+                ra_mean.value = (
+                    self.momentum * ra_mean.value + (1 - self.momentum) * mean
+                )
+                ra_var.value = (
+                    self.momentum * ra_var.value + (1 - self.momentum) * var
+                )
+        y = (xf - mean[:, None]) * lax.rsqrt(var[:, None] + self.epsilon)
+        y = y * scale[:, None] + bias[:, None]
+        return y.reshape(b, h, wp, c2).astype(self.dtype)
 
 
 class NormFactory:
@@ -188,6 +376,15 @@ class NormFactory:
             name=name,
         )
 
+    def packed(self, name: str, train: bool) -> Callable:
+        """The same norm, applied on the width-packed layout (same params)."""
+        if self.kind == "gn":
+            return PackedGroupNorm(dtype=self.dtype, name=name)
+        use_running = (self.kind == "frozen_bn") or (not train)
+        return PackedBatchNorm(
+            use_running_average=use_running, dtype=self.dtype, name=name
+        )
+
 
 class BottleneckBlock(nn.Module):
     """1x1 → 3x3(stride) → 1x1(x4) with projection shortcut on shape change."""
@@ -196,6 +393,7 @@ class BottleneckBlock(nn.Module):
     stride: int
     norm: NormFactory
     dtype: jnp.dtype = jnp.bfloat16
+    packed: bool = False  # width-packed layout (stride must be 1)
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> jnp.ndarray:
@@ -203,28 +401,37 @@ class BottleneckBlock(nn.Module):
         # SAME for stride 1, but for stride 2 on even dims SAME pads (0, 1)
         # — a one-pixel grid shift that would misalign imported pretrained
         # features.  Output sizes are ceil(d/s) either way.
-        conv = lambda f, k, s, name: nn.Conv(  # noqa: E731
-            f,
-            (k, k),
-            strides=(s, s),
-            padding=((k // 2, k // 2), (k // 2, k // 2)),
-            use_bias=False,
-            dtype=self.dtype,
-            param_dtype=jnp.float32,
-            name=name,
-        )
+        if self.packed:
+            if self.stride != 1:
+                raise ValueError("packed bottleneck blocks require stride 1")
+            conv = lambda f, k, s, name: PackedConv(  # noqa: E731
+                features=f, kernel_size=k, dtype=self.dtype, name=name
+            )
+            norm_for = lambda name: self.norm.packed(name, train)  # noqa: E731
+        else:
+            conv = lambda f, k, s, name: nn.Conv(  # noqa: E731
+                f,
+                (k, k),
+                strides=(s, s),
+                padding=((k // 2, k // 2), (k // 2, k // 2)),
+                use_bias=False,
+                dtype=self.dtype,
+                param_dtype=jnp.float32,
+                name=name,
+            )
+            norm_for = lambda name: self.norm(name, train)  # noqa: E731
         residual = x
         y = conv(self.filters, 1, 1, "conv1")(x)
-        y = self.norm("norm1", train)(y)
+        y = norm_for("norm1")(y)
         y = nn.relu(y)
         y = conv(self.filters, 3, self.stride, "conv2")(y)
-        y = self.norm("norm2", train)(y)
+        y = norm_for("norm2")(y)
         y = nn.relu(y)
         y = conv(self.filters * 4, 1, 1, "conv3")(y)
-        y = self.norm("norm3", train)(y)
+        y = norm_for("norm3")(y)
         if residual.shape != y.shape:
             residual = conv(self.filters * 4, 1, self.stride, "proj")(x)
-            residual = self.norm("proj_norm", train)(residual)
+            residual = norm_for("proj_norm")(residual)
         return nn.relu(y + residual)
 
 
@@ -235,6 +442,10 @@ class ResNet(nn.Module):
     norm_kind: str = "gn"
     dtype: jnp.dtype = jnp.bfloat16
     stem: str = "conv"  # "conv" | "space_to_depth" | "space_to_depth4"
+    # Run stage2 (the C=64 stage — PARITY.md's worst MXU slice) with W-pairs
+    # packed into channels; math-identical, same param tree (see the
+    # width-packing block above).  Needs stage2 width (ceil(W_img/4)) even.
+    pack_width: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> dict[str, jnp.ndarray]:
@@ -261,14 +472,25 @@ class ResNet(nn.Module):
         filters = 64
         for stage, num_blocks in enumerate(self.stage_sizes):
             stride = 1 if stage == 0 else 2
+            packed = self.pack_width and stage == 0  # all-stride-1 C=64 stage
+            if packed:
+                if x.shape[2] % 2:
+                    raise ValueError(
+                        f"pack_width needs an even stage2 width; got "
+                        f"{x.shape[2]} (make W divisible by 8)"
+                    )
+                x = _pack_w(x)
             for block in range(num_blocks):
                 x = BottleneckBlock(
                     filters=filters,
                     stride=stride if block == 0 else 1,
                     norm=norm,
                     dtype=self.dtype,
+                    packed=packed,
                     name=f"stage{stage + 2}_block{block}",
                 )(x, train=train)
+            if packed:
+                x = _unpack_w(x)
             if stage >= 1:  # C3 at stride 8, C4 at 16, C5 at 32
                 features[f"c{stage + 2}"] = x
             filters *= 2
@@ -279,7 +501,12 @@ def resnet50(
     norm_kind: str = "gn",
     dtype: jnp.dtype = jnp.bfloat16,
     stem: str = "conv",
+    pack_width: bool = False,
 ) -> ResNet:
     return ResNet(
-        stage_sizes=(3, 4, 6, 3), norm_kind=norm_kind, dtype=dtype, stem=stem
+        stage_sizes=(3, 4, 6, 3),
+        norm_kind=norm_kind,
+        dtype=dtype,
+        stem=stem,
+        pack_width=pack_width,
     )

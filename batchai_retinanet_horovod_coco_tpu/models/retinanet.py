@@ -38,6 +38,15 @@ class RetinaNetConfig:
     # measured 3.7% faster end-to-end on v5e (the plain 3-channel stem runs
     # the MXU at ~4% occupancy).  "conv" restores the canonical form.
     stem: str = "space_to_depth"
+    # Width-packed stage2 (models/resnet.py): the C=64 stage runs with W
+    # pairs folded into channels so its convs fill the 128-lane MXU —
+    # math-identical, same param tree.  MEASURED NEGATIVE at the flagship
+    # bucket on v5e (58.3 vs 60.7 imgs/s at b8: stage2 is mostly
+    # bandwidth-bound there, so the packed kernels' 2x MACs cost more than
+    # the lane-occupancy win; PARITY.md round 3).  Kept as an exact,
+    # tested reformulation for narrow-channel-bound shapes/hardware.
+    # ResNet backbones only; needs W_img divisible by 8.
+    pack_width: bool = False
     fpn_channels: int = 256
     head_width: int = 256
     head_depth: int = 4
@@ -78,12 +87,18 @@ def build_backbone(cfg: "RetinaNetConfig"):
     """
     name = cfg.backbone
     stages = _BACKBONE_STAGES.get(name)
+    if cfg.pack_width and stages is None:
+        raise ValueError(
+            f"pack_width is a ResNet-stage2 reformulation; backbone "
+            f"{name!r} does not support it"
+        )
     if stages is not None:
         return ResNet(
             stage_sizes=stages,
             norm_kind=cfg.norm_kind,
             dtype=cfg.dtype,
             stem=cfg.stem,
+            pack_width=cfg.pack_width,
             name="backbone",
         )
     if name in ("mobilenet", "mobilenet050"):
@@ -128,16 +143,18 @@ class RetinaNet(nn.Module):
         self,
         images: jnp.ndarray,
         train: bool = False,
-        return_levels: bool = False,
+        return_levels: bool | str = False,
     ) -> dict[str, Any]:
         """(B, H, W, 3) float images → {"cls_logits": (B, A, K), "box_deltas": (B, A, 4)}.
 
-        ``return_levels=True`` returns the PER-LEVEL outputs instead
-        ({"cls_levels": tuple of (B, A_l, K), "box_levels": ...}, P3→P7 in
-        anchor order) and skips the concatenation, for consumers like
-        ``losses.total_loss_compact_levels`` (measured slightly SLOWER than
-        the concatenated form in the flagship train step — see that
-        function's docstring — so the step does not use it).
+        ``return_levels=True`` returns the PER-LEVEL anchor-major outputs
+        instead ({"cls_levels": tuple of (B, A_l, K), "box_levels": ...},
+        P3→P7 in anchor order) and skips the concatenation.
+        ``return_levels="nhwc"`` returns the RAW conv outputs per level
+        ((B, h_l, w_l, A·K) / (B, h_l, w_l, A·4)) — no anchor-major retile,
+        no concat; the train step consumes this via
+        ``losses.total_loss_compact_nhwc`` (the retile+concat+split complex
+        measured ~4 ms of the b8 flagship step, round-3 profile).
         """
         cfg = self.config
         # named_scope: phase labels in profiler traces (SURVEY.md §5.1).
@@ -165,13 +182,20 @@ class RetinaNet(nn.Module):
             name="box_head",
         )
 
+        flatten = return_levels != "nhwc"
         cls_out, box_out = [], []
         with jax.named_scope("heads"):
             for level in cfg.anchor.levels:  # P3 → P7, matching anchor order
                 feat = pyramid[f"p{level}"]
-                cls_out.append(cls_head(feat))
-                box_out.append(box_head(feat))
+                cls_out.append(cls_head(feat, flatten=flatten))
+                box_out.append(box_head(feat, flatten=flatten))
 
+        if return_levels == "nhwc":
+            # Raw dtype (bf16): an f32 cast here would double the final
+            # head convs' output writes (~516 MB/step at the flagship
+            # bucket); the nhwc loss casts f32 inside its elementwise
+            # fusion instead.
+            return {"cls_levels": tuple(cls_out), "box_levels": tuple(box_out)}
         if return_levels:
             # Losses run in f32; cast per level (fuses into the head convs).
             return {

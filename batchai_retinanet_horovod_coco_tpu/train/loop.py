@@ -33,6 +33,30 @@ from batchai_retinanet_horovod_coco_tpu.utils.checkpoint import CheckpointManage
 from batchai_retinanet_horovod_coco_tpu.utils.metrics import MetricLogger
 
 
+# With --log-every 0 the loop still pulls the loss scalar at this cadence so
+# a NaN cannot train garbage for the rest of a long run before aborting
+# (SURVEY.md §5.2; the log-boundary-only check was a real hole at
+# log_every=0).  One scalar fetch per window is noise next to step time.
+_FINITE_CHECK_EVERY = 100
+
+
+# The metrics whose finiteness gates checkpointing: ``loss`` witnesses the
+# pre-update params, ``param_norm`` the post-update ones (a save at the very
+# step whose update introduced the poison is only caught by the latter —
+# the step-N loss is computed before the step-N update, train/step.py).
+_SENTINEL_METRICS = ("loss", "param_norm")
+
+
+def _assert_finite(value, name: str, step: int, cadence: str) -> None:
+    """Numerical sanitizer (SURVEY.md §5.2): abort on a non-finite metric."""
+    if not np.isfinite(value):
+        raise FloatingPointError(
+            f"non-finite {name} ({float(value)}) at or before step {step} "
+            f"(checked {cadence}); rerun with --debug-nans to locate the "
+            "originating op"
+        )
+
+
 @dataclasses.dataclass(frozen=True)
 class LoopConfig:
     total_steps: int = 1000
@@ -231,24 +255,32 @@ def run_training(
         )
 
         # ``step`` is tracked host-side (state.step mirrors it) so the loop
-        # never forces a per-step device sync on tunneled TPU backends.
-        if (
+        # never forces a per-step device sync on tunneled TPU backends; the
+        # finiteness sanitizer therefore runs at a bounded cadence — every
+        # log window, every _FINITE_CHECK_EVERY steps when log_every=0, and
+        # unconditionally before any checkpoint save (a NaN-poisoned state
+        # must never reach disk: auto-resume would restore the poison and
+        # make recovery impossible without --no-resume).
+        is_log = (
             config.log_every and step % config.log_every == 0
-        ) or step == config.total_steps:
+        ) or step == config.total_steps
+        will_save = ckpt is not None and ckpt.should_save(step)
+        check_every = config.log_every or _FINITE_CHECK_EVERY
+        cadence = (
+            f"every {check_every} steps and before each checkpoint save"
+        )
+        if not is_log and (will_save or step % check_every == 0):
+            for name in _SENTINEL_METRICS:
+                if name in metrics:
+                    _assert_finite(
+                        jax.device_get(metrics[name]), name, step, cadence
+                    )
+
+        if is_log:
             scalars = {k: v for k, v in jax.device_get(metrics).items()}
-            # Numerical sanitizer (SURVEY.md §5.2): a non-finite loss aborts
-            # with the offending step instead of silently training garbage.
-            if "loss" in scalars and not np.isfinite(scalars["loss"]):
-                checked = (
-                    f"every {config.log_every} steps"
-                    if config.log_every
-                    else "only at the final step (log_every=0)"
-                )
-                raise FloatingPointError(
-                    f"non-finite loss ({float(scalars['loss'])}) at or "
-                    f"before step {step} (loss is checked {checked}); rerun "
-                    "with --debug-nans to locate the originating op"
-                )
+            for name in _SENTINEL_METRICS:
+                if name in scalars:
+                    _assert_finite(scalars[name], name, step, cadence)
             dt = time.perf_counter() - window_t0
             scalars["images_per_sec"] = window_images / max(dt, 1e-9)
             # Step-time breakdown (SURVEY.md §5.5): how much of the step the
@@ -275,7 +307,7 @@ def run_training(
             window_data_wait = 0.0
             window_steps = 0
 
-        if ckpt is not None and ckpt.save(state, step=step):
+        if will_save and ckpt.save(state, step=step):
             last_saved = step
 
         if (

@@ -53,13 +53,19 @@ def _forward_and_loss(
     # uint8 batches normalize here, on device (data/pipeline.normalize_images).
     images = pipeline_lib.normalize_images(images)
 
+    # NHWC-direct loss path: raw per-level head outputs, no anchor-major
+    # retile/concat (losses.total_loss_compact_nhwc — measured ~4 ms/step
+    # of layout traffic at the flagship bucket).  The Pallas focal kernel
+    # consumes the concatenated (B, A, K) form instead.
+    return_levels = False if loss_config.pallas_focal else "nhwc"
+    apply_kwargs = dict(train=train, return_levels=return_levels)
     if has_bn and train:
         outputs, mutated = model.apply(
-            variables, images, train=True, mutable=["batch_stats"]
+            variables, images, mutable=["batch_stats"], **apply_kwargs
         )
         new_batch_stats = mutated["batch_stats"]
     else:
-        outputs = model.apply(variables, images, train=train)
+        outputs = model.apply(variables, images, **apply_kwargs)
         new_batch_stats = state.batch_stats
 
     # On-device target assignment; no gradients flow into the matching.
@@ -72,14 +78,25 @@ def _forward_and_loss(
     )
     targets = jax.tree.map(lax.stop_gradient, targets)
 
-    metrics = losses_lib.total_loss_compact(
-        outputs["cls_logits"],
-        outputs["box_deltas"],
-        targets.matched_labels,
-        targets.box_targets,
-        targets.state,
-        loss_config,
-    )
+    if return_levels == "nhwc":
+        metrics = losses_lib.total_loss_compact_nhwc(
+            outputs["cls_levels"],
+            outputs["box_levels"],
+            targets.matched_labels,
+            targets.box_targets,
+            targets.state,
+            model.config.anchors_per_location,
+            loss_config,
+        )
+    else:
+        metrics = losses_lib.total_loss_compact(
+            outputs["cls_logits"],
+            outputs["box_deltas"],
+            targets.matched_labels,
+            targets.box_targets,
+            targets.state,
+            loss_config,
+        )
     metrics["num_pos"] = jnp.sum(
         (targets.state == matching_lib.POSITIVE).astype(jnp.float32)
     )
@@ -161,6 +178,12 @@ def make_train_step(
             new_state = state.apply_gradients(
                 grads, new_bs, loss_value=metrics["loss"]
             )
+            # Norm of the POST-update params: the loss above was computed
+            # from the pre-update params, so it cannot witness a poisoned
+            # update — this can, and the loop checks it before any
+            # checkpoint save (a norm read of params the next step reloads
+            # anyway; cost is noise).
+            metrics["param_norm"] = optax.global_norm(new_state.params)
             return new_state, metrics
 
         return train_step
@@ -212,6 +235,9 @@ def make_train_step(
                     loss_value=metrics["loss"],
                 )
                 metrics.update(info)
+                # Post-update param norm (see the single-device step): the
+                # gathered new_params are replicated, so the norm is too.
+                metrics["param_norm"] = optax.global_norm(new_params)
                 new_state = state.replace(
                     step=state.step + 1,
                     params=new_params,
@@ -268,6 +294,8 @@ def make_train_step(
         new_state = state.apply_gradients(
             grads, new_bs, loss_value=metrics["loss"]
         )
+        # Post-update param norm (see the single-device step for why).
+        metrics["param_norm"] = optax.global_norm(new_state.params)
         return new_state, metrics
 
     return jax.jit(sharded_step, donate_argnums=(0,) if donate_state else ())

@@ -66,6 +66,15 @@ class CheckpointManager:
             force=force,
         )
 
+    def should_save(self, step: int) -> bool:
+        """Would ``save(step)`` actually write (interval/dedup policy)?
+
+        Lets the training loop run pre-save checks (e.g. the non-finite-loss
+        abort) only when a save is really about to happen, instead of paying
+        a device sync every step.
+        """
+        return self._mgr.should_save(step)
+
     def restore(self, state: TrainState, step: int | None = None) -> TrainState:
         """Restore into the structure of ``state`` (shapes/shardings template).
 

@@ -65,6 +65,34 @@ def test_small_leaves_stay_exact():
     np.testing.assert_array_equal(np.asarray(q["b"]), np.asarray(exact["b"]))
 
 
+def test_outlier_does_not_zero_distant_blocks():
+    """Per-block scales (ADVICE r2): one huge outlier must not collapse the
+    rest of the shard to zero, as a single per-shard scale would (every
+    element below max/254 rounds to 0 → 100% relative error)."""
+    from batchai_retinanet_horovod_coco_tpu.parallel.quantize import _QUANT_BLOCK
+
+    rng = np.random.default_rng(5)
+    shard_len = 8 * _QUANT_BLOCK  # per-device reduced shard, several blocks
+    big = rng.normal(0, 1e-3, (N, N * shard_len)).astype(np.float32)
+    # One outlier in block 0 of EVERY device's reduced shard (psum_scatter
+    # gives device s the flat slice [s*shard_len, (s+1)*shard_len)), so the
+    # per-block property is exercised on all shards, not just shard 0.
+    for s in range(N):
+        big[:, s * shard_len] = 1e3
+    q, exact = _run_both({"w": jnp.asarray(big)})
+    q_np, e_np = np.asarray(q["w"]), np.asarray(exact["w"])
+    # Outside the outlier's block, relative error stays small.
+    mask = np.ones_like(e_np, dtype=bool)
+    for s in range(N):
+        mask[s * shard_len : s * shard_len + _QUANT_BLOCK] = False
+    rel = np.abs(q_np[mask] - e_np[mask]) / np.maximum(np.abs(e_np[mask]), 1e-12)
+    assert np.median(rel) < 0.05, "distant blocks lost to the outlier's scale"
+    # (~1% of N(0,1e-3) entries sit below their block's scale/2 and round to
+    # zero legitimately; a per-shard scale would zero essentially ALL of
+    # them — the cutoff there is 1e3/254, three decades above the data.)
+    assert np.count_nonzero(q_np[mask]) > 0.95 * mask.sum()
+
+
 def test_zero_gradients_exact():
     z = jnp.zeros((N, 16, 1024), jnp.float32)
     q, exact = _run_both({"w": z})

@@ -130,9 +130,31 @@ class TestRunTraining:
 
 
 def test_non_finite_loss_aborts_with_step_number():
-    """SURVEY.md §5.2 numerical sanitizer: LR=inf poisons the params after
-    the first update; the loop must abort with the offending step instead
-    of training garbage."""
+    """SURVEY.md §5.2 numerical sanitizer: LR=inf poisons the params in the
+    first update; the post-update param_norm sentinel catches it AT step 1
+    (the step-2 loss would be the first pre-update witness) and the loop
+    aborts instead of training garbage."""
+    model = tiny_model()
+    state = create_train_state(
+        model, optax.sgd(float("inf")), (1, *HW, 3), jax.random.key(0)
+    )
+    with pytest.raises(FloatingPointError, match="before step 1"):
+        run_training(
+            model,
+            state,
+            batch_stream(),
+            NUM_CLASSES,
+            LoopConfig(total_steps=3, log_every=1),
+        )
+
+
+def test_non_finite_abort_fires_early_with_log_every_zero(monkeypatch):
+    """log_every=0 must NOT defer the sanitizer to the final step: the loop
+    checks every _FINITE_CHECK_EVERY steps regardless (shrunk here so the
+    test stays cheap)."""
+    from batchai_retinanet_horovod_coco_tpu.train import loop as loop_mod
+
+    monkeypatch.setattr(loop_mod, "_FINITE_CHECK_EVERY", 2)
     model = tiny_model()
     state = create_train_state(
         model, optax.sgd(float("inf")), (1, *HW, 3), jax.random.key(0)
@@ -143,8 +165,39 @@ def test_non_finite_loss_aborts_with_step_number():
             state,
             batch_stream(),
             NUM_CLASSES,
-            LoopConfig(total_steps=3, log_every=1),
+            LoopConfig(total_steps=50, log_every=0),
+        )  # step 1 has no check (1 % 2 != 0, no save); step 2 aborts
+
+
+def test_non_finite_state_never_checkpointed(tmp_path):
+    """The abort runs BEFORE each checkpoint save and checks the
+    POST-update param_norm, so a state poisoned by this very step's update
+    never reaches disk — auto-resume can only ever see finite params
+    (ADVICE r2; the pre-update loss alone would have let step 1's poisoned
+    snapshot through)."""
+    from batchai_retinanet_horovod_coco_tpu.utils.checkpoint import latest_step
+
+    model = tiny_model()
+    state = create_train_state(
+        model, optax.sgd(float("inf")), (1, *HW, 3), jax.random.key(0)
+    )
+    ckpt_dir = str(tmp_path / "ckpt")
+    with pytest.raises(FloatingPointError):
+        run_training(
+            model,
+            state,
+            batch_stream(),
+            NUM_CLASSES,
+            LoopConfig(
+                total_steps=10,
+                log_every=0,
+                checkpoint_every=1,
+                checkpoint_dir=ckpt_dir,
+            ),
         )
+    # Step 1's update already poisoned the params; its param_norm sentinel
+    # must have aborted before ANY snapshot landed.
+    assert latest_step(ckpt_dir) is None
 
 
 def test_debug_nans_flag_parses():

@@ -201,3 +201,62 @@ def test_levels_size_mismatch_raises():
             np.zeros((1, 12, 4)),
             np.zeros((1, 12), np.int32),
         )
+
+
+def test_nhwc_matches_concat():
+    """NHWC-direct per-level losses == concatenated losses (f32 sum order).
+
+    The step's hot path (train/step.py) consumes raw (B, h, w, A*K) head
+    outputs; level anchor counts are h*w*A in (y, x, a) order, matching the
+    anchor-major flatten the heads would otherwise do.
+    """
+    from batchai_retinanet_horovod_coco_tpu.losses import (
+        total_loss_compact,
+        total_loss_compact_nhwc,
+    )
+
+    rng = np.random.default_rng(11)
+    B, K, A_LOC = 2, 5, 3
+    level_hw = ((10, 12), (5, 6), (3, 3))
+    level_sizes = [h * w * A_LOC for h, w in level_hw]
+    A = sum(level_sizes)
+    logits = rng.normal(0, 2, (B, A, K)).astype(np.float32)
+    box_preds = rng.normal(0, 1, (B, A, 4)).astype(np.float32)
+    box_t = rng.normal(0, 1, (B, A, 4)).astype(np.float32)
+    labels = rng.integers(0, K, (B, A)).astype(np.int32)
+    state = rng.choice([-1, 0, 1], (B, A), p=[0.2, 0.7, 0.1]).astype(np.int32)
+
+    cls_levels, box_levels, off = [], [], 0
+    for (h, w), n in zip(level_hw, level_sizes):
+        cls_levels.append(
+            logits[:, off : off + n].reshape(B, h, w, A_LOC * K)
+        )
+        box_levels.append(
+            box_preds[:, off : off + n].reshape(B, h, w, A_LOC * 4)
+        )
+        off += n
+
+    want = total_loss_compact(logits, box_preds, labels, box_t, state)
+    got = total_loss_compact_nhwc(
+        tuple(cls_levels), tuple(box_levels), labels, box_t, state, A_LOC
+    )
+    for k in want:
+        np.testing.assert_allclose(float(got[k]), float(want[k]), rtol=1e-5)
+
+
+def test_nhwc_size_mismatch_raises():
+    from batchai_retinanet_horovod_coco_tpu.losses import (
+        total_loss_compact_nhwc,
+    )
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="cover"):
+        total_loss_compact_nhwc(
+            (np.zeros((1, 2, 2, 6)),),
+            (np.zeros((1, 2, 2, 8)),),
+            np.zeros((1, 12), np.int32),
+            np.zeros((1, 12, 4)),
+            np.zeros((1, 12), np.int32),
+            2,
+        )

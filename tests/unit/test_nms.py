@@ -55,7 +55,7 @@ def test_single_class_simple_suppression():
 
 
 def test_multiclass_keeps_classes_separate():
-    # Identical boxes, different classes: both survive (class-offset trick).
+    # Identical boxes, different classes: both survive (class-masked NMS).
     boxes = np.array([[0, 0, 10, 10], [0, 0, 10, 10]], dtype=np.float32)
     scores = np.array([[0.9, 0.0], [0.0, 0.8]], dtype=np.float32)
     det = multiclass_nms(boxes, scores, score_threshold=0.05, max_detections=10)
@@ -88,6 +88,55 @@ def test_multiclass_fixed_output_shape():
     assert det.scores.shape == (25,)
     assert det.labels.shape == (25,)
     assert not np.any(np.asarray(det.valid))
+
+
+def test_multiclass_flagship_coords_vs_per_class_oracle():
+    """Exact per-class NMS at flagship-scale coordinates and high class ids.
+
+    Guards the regime the old class-offset trick got wrong: 80 classes with
+    coordinates up to 1333 px, where offsetting class-79 boxes by 79e4 put
+    them at f32 ulp ~0.06 px and borderline IoU decisions could flip.  The
+    oracle here runs true per-class greedy NMS on the RAW coordinates, with
+    near-threshold IoU pairs crafted in, and must match exactly.
+    """
+    rng = np.random.default_rng(7)
+    num_classes = 80
+    per_class = 6
+    boxes_list, scores_rows = [], []
+    for c in range(num_classes):
+        # Clustered boxes per class so many pairs sit near the 0.5 threshold.
+        base_xy = rng.uniform(0, 1200, size=(per_class, 2))
+        jitter = rng.uniform(-8, 8, size=(per_class, 2))
+        xy = np.clip(base_xy[0] + jitter, 0, 1300)
+        wh = rng.uniform(20, 120, size=(per_class, 2))
+        b = np.concatenate([xy, xy + wh], axis=1)
+        boxes_list.append(b)
+        row = np.zeros((per_class, num_classes))
+        row[:, c] = rng.uniform(0.1, 1.0, size=per_class)
+        scores_rows.append(row)
+    boxes = np.concatenate(boxes_list).astype(np.float32)
+    scores = np.concatenate(scores_rows).astype(np.float32)
+
+    det = multiclass_nms(
+        boxes, scores, score_threshold=0.05, iou_threshold=0.5, max_detections=480
+    )
+    valid = np.asarray(det.valid)
+    # Scores pass through the device path ungathered-unmodified, so the
+    # survivors' (label, score) pairs must match the oracle's bit-exactly.
+    got = sorted(
+        zip(
+            np.asarray(det.labels)[valid].tolist(),
+            np.asarray(det.scores)[valid].tolist(),
+        )
+    )
+
+    expected = []
+    for c in range(num_classes):
+        cls_mask = scores[:, c] > 0.05
+        idx = np.flatnonzero(cls_mask)
+        keep = numpy_greedy_nms(boxes[idx], scores[idx, c], 0.5)
+        expected.extend((c, float(scores[idx[i], c])) for i in keep)
+    assert got == sorted(expected)
 
 
 def test_batched_nms_accepts_kwargs():

@@ -1,0 +1,115 @@
+"""Width-packed stage2 (models/resnet.py pack_width) is math-identical.
+
+The packed path re-expresses every stage2 op on a (B, H, W/2, 2C) layout
+with block-structured kernels; its defining property is exact equivalence
+to the plain path UNDER THE SAME PARAMS.  These tests build both variants,
+initialize one, and run the other with the identical tree — possible only
+because PackedConv / Packed*Norm declare canonical param shapes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from batchai_retinanet_horovod_coco_tpu.models.resnet import (
+    ResNet,
+    _pack_kernel_1x1,
+    _pack_kernel_3x3,
+    _pack_w,
+    _unpack_w,
+)
+
+HW = (32, 48)  # stage2 width 12: even, exercises several packed columns
+
+
+def _build(pack, norm_kind):
+    return ResNet(
+        stage_sizes=(2, 1, 1, 1),
+        norm_kind=norm_kind,
+        dtype=jnp.float32,  # f32 so the comparison tolerance can be tight
+        stem="conv",
+        pack_width=pack,
+    )
+
+
+def _input(seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.normal(0, 1, (2, *HW, 3)).astype(np.float32))
+
+
+def test_pack_roundtrip():
+    x = _input()
+    np.testing.assert_array_equal(np.asarray(_unpack_w(_pack_w(x))), np.asarray(x))
+
+
+def test_packed_kernels_shapes():
+    k1 = jnp.ones((1, 1, 4, 6))
+    k3 = jnp.ones((3, 3, 4, 6))
+    assert _pack_kernel_1x1(k1).shape == (1, 1, 8, 12)
+    assert _pack_kernel_3x3(k3).shape == (3, 3, 8, 12)
+
+
+@pytest.mark.parametrize("norm_kind", ["gn", "frozen_bn", "bn"])
+def test_packed_forward_matches_plain(norm_kind):
+    x = _input()
+    plain, packed = _build(False, norm_kind), _build(True, norm_kind)
+    variables = plain.init(jax.random.key(0), x)
+    # Same tree structure/shapes — the checkpoint-compatibility contract.
+    packed_vars = packed.init(jax.random.key(0), x)
+    assert jax.tree.structure(variables) == jax.tree.structure(packed_vars)
+    jax.tree.map(lambda a, b: (a.shape == b.shape) or (_ for _ in ()).throw(
+        AssertionError(f"{a.shape} != {b.shape}")), variables, packed_vars)
+
+    for train in (False, True):
+        kw = {}
+        if norm_kind == "bn" and train:
+            kw["mutable"] = ["batch_stats"]
+        out_p = plain.apply(variables, x, train=train, **kw)
+        out_q = packed.apply(variables, x, train=train, **kw)
+        if kw:
+            (out_p, bs_p), (out_q, bs_q) = out_p, out_q
+            jax.tree.map(
+                lambda a, b: np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+                ),
+                bs_p,
+                bs_q,
+            )
+        for key in ("c3", "c4", "c5"):
+            np.testing.assert_allclose(
+                np.asarray(out_q[key]),
+                np.asarray(out_p[key]),
+                rtol=1e-4,
+                atol=1e-4,
+                err_msg=f"{norm_kind} train={train} {key}",
+            )
+
+
+def test_odd_stage2_width_rejected():
+    x = jnp.zeros((1, 32, 36, 3))  # stage2 width ceil(36/4) = 9, odd
+    model = _build(True, "gn")
+    with pytest.raises(ValueError, match="even stage2 width"):
+        model.init(jax.random.key(0), x)
+
+
+def test_grads_match_plain():
+    """Autodiff through the kernel repack must produce the PLAIN gradients
+    (the structurally-zero blocks' cotangents drop in the gather transpose)."""
+    x = _input(1)
+    plain, packed = _build(False, "gn"), _build(True, "gn")
+    variables = plain.init(jax.random.key(0), x)
+
+    def loss(params, model):
+        out = model.apply({"params": params}, x, train=True)
+        return sum(jnp.sum(o * o) for o in out.values())
+
+    g_p = jax.grad(loss)(variables["params"], plain)
+    g_q = jax.grad(loss)(variables["params"], packed)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-3
+        ),
+        g_p,
+        g_q,
+    )

@@ -140,6 +140,13 @@ def build_parser() -> argparse.ArgumentParser:
                        help="stem formulation; space_to_depth is the "
                             "math-identical MLPerF reformulation, ~4%% "
                             "faster on TPU (models/resnet.py)")
+        g.add_argument("--pack-width", action="store_true",
+                       help="width-packed stage2 (ResNet only): fold W "
+                            "pairs into channels so the C=64 stage fills "
+                            "the MXU lanes; math-identical, measured "
+                            "SLOWER on v5e at the flagship bucket "
+                            "(bandwidth-bound stage) — opt-in for "
+                            "narrow-channel-bound shapes (models/resnet.py)")
         g.add_argument("--f32", action="store_true",
                        help="compute in float32 (default bfloat16)")
         # Anchor hyperparameters (keras-retinanet --config ini parity,
@@ -406,6 +413,7 @@ def main(argv=None) -> dict[str, float]:
             backbone=args.backbone,
             norm_kind=args.norm,
             stem=args.stem,
+            pack_width=getattr(args, "pack_width", False),
             anchor=anchor_config,
             dtype=jnp.float32 if args.f32 else jnp.bfloat16,
         )
@@ -532,6 +540,13 @@ def main(argv=None) -> dict[str, float]:
                 replicated_sharding,
             )
 
+            # Detection needs only params/batch_stats/step.  Drop opt_state
+            # BEFORE the host round-trip: (a) under --shard-weight-update the
+            # optimizer slots are sharded P(DATA_AXIS) over the global mesh,
+            # so their shards are non-addressable from one host and
+            # device_get would raise; (b) even replicated, it halves the
+            # per-eval host<->device traffic (optimizer slots ~= params).
+            eval_state = eval_state.replace(opt_state=())
             eval_state = jax.device_put(
                 jax.device_get(eval_state), replicated_sharding(eval_mesh)
             )

@@ -177,7 +177,20 @@ def run_training(
                 # need cross-host transfers (unsupported on some backends).
                 # Every process restored identical values, so pull to host
                 # and let the replication below proceed host-locally.
-                state = jax.device_get(state)
+                if shard_weight_update:
+                    # The sharded optimizer state was restored by orbax
+                    # directly into its global 1/N layout (the restore
+                    # template carries the sharding); its shards are
+                    # non-addressable cross-host, so it must NOT be pulled
+                    # — and need not be: it is already where the step wants
+                    # it.  Only the replicated leaves round-trip.
+                    state = state.replace(
+                        step=jax.device_get(state.step),
+                        params=jax.device_get(state.params),
+                        batch_stats=jax.device_get(state.batch_stats),
+                    )
+                else:
+                    state = jax.device_get(state)
 
     if mesh is not None:
         # Replicate state over the mesh (restored arrays land committed to a

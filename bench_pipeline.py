@@ -1,0 +1,114 @@
+"""Host input-pipeline saturation bench: decode+resize+assemble imgs/s vs workers.
+
+The input pipeline is the classic scaling-efficiency killer for detection
+workloads (SURVEY.md §7.3 part 6): at pod scale every host must decode
+enough images per second to feed its chips (~4 chips/host on v5e, so
+4 x chip-throughput imgs/s/host).  This bench measures the REAL pipeline —
+JPEG decode, multiscale resize to the flagship buckets, pad/assemble,
+target-free (targets are computed on device) — against worker count, and
+prints one JSON line:
+
+  {"metric": "host_pipeline_images_per_sec", "value": <best>,
+   "per_worker": {"1": ..., "2": ..., ...}, "cores_available": N, ...}
+
+Run it on the actual pod host class to validate the scaling argument in
+PARITY.md; the committed PIPEBENCH.json records this dev box's numbers
+(note its core count — per-core throughput is the portable figure).
+
+Usage: python bench_pipeline.py [--images N] [--batches N] [--workers 1,2,4,8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import tempfile
+import time
+
+
+def run_one(data_dir: str, num_workers: int, batches: int, batch_size: int) -> float:
+    from batchai_retinanet_horovod_coco_tpu.data import (
+        CocoDataset,
+        PipelineConfig,
+        build_pipeline,
+    )
+    from batchai_retinanet_horovod_coco_tpu.data.pipeline import default_buckets
+
+    dataset = CocoDataset(
+        os.path.join(data_dir, "instances_train.json"),
+        os.path.join(data_dir, "train"),
+    )
+    pipe = build_pipeline(
+        dataset,
+        PipelineConfig(
+            batch_size=batch_size,
+            buckets=default_buckets(800, 1344),
+            min_side=800,
+            max_side=1344,
+            max_gt=100,
+            num_workers=num_workers,
+            seed=0,
+        ),
+        train=True,
+    )
+    it = iter(pipe)
+    next(it)  # warmup: thread pool spin-up + first-batch latency
+    t0 = time.perf_counter()
+    n = 0
+    for _ in range(batches):
+        batch = next(it)
+        n += batch.images.shape[0]
+    dt = time.perf_counter() - t0
+    pipe.close()
+    return n / dt
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--images", type=int, default=64,
+                    help="synthetic JPEG count (COCO-typical 640x480)")
+    ap.add_argument("--batches", type=int, default=6)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--workers", default="1,2,4,8")
+    ap.add_argument("--data-dir", default=None,
+                    help="existing COCO-format dir (default: synthesize)")
+    args = ap.parse_args()
+
+    from batchai_retinanet_horovod_coco_tpu.data import make_synthetic_coco
+
+    tmp = None
+    data_dir = args.data_dir
+    if data_dir is None:
+        tmp = tempfile.TemporaryDirectory(prefix="pipebench_")
+        data_dir = tmp.name
+        # COCO-typical source resolution so decode+resize cost is realistic.
+        make_synthetic_coco(
+            data_dir, num_images=args.images, num_classes=8,
+            image_size=(480, 640), seed=0, split="train",
+        )
+
+    per_worker: dict[str, float] = {}
+    for w in [int(x) for x in args.workers.split(",")]:
+        per_worker[str(w)] = round(run_one(
+            data_dir, w, args.batches, args.batch_size
+        ), 2)
+
+    best = max(per_worker.values())
+    cores = len(os.sched_getaffinity(0))
+    print(json.dumps({
+        "metric": "host_pipeline_images_per_sec",
+        "value": best,
+        "unit": "images/sec/host",
+        "per_worker": per_worker,
+        "cores_available": cores,
+        "per_core": round(best / max(cores, 1), 2),
+        "source_resolution": "640x480 JPEG",
+        "target": "800x1344-bucketed multiscale resize + pad",
+    }))
+    if tmp is not None:
+        tmp.cleanup()
+
+
+if __name__ == "__main__":
+    main()

@@ -22,6 +22,20 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compilation cache: the suite's dominant cost is XLA recompiling
+# the SAME tiny train/detect programs in every test (make_train_step builds
+# a fresh closure per call, so the in-process trace cache never hits).  The
+# on-disk cache is keyed on the HLO hash, so identical programs compile once
+# per MACHINE, not once per test — measured: test_loop.py 649 s cold →
+# ~5 min warm.  Safe across code changes (changed programs hash differently)
+# and shared with the 2-process pod-test workers via the env var below.
+_CACHE_DIR = os.path.join(os.path.dirname(__file__), ".jax_cache")
+jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+# Subprocess workers (tests/distributed/pod_*.py) inherit the cache via env.
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _CACHE_DIR)
+
 import jax.numpy as jnp  # noqa: E402
 import optax  # noqa: E402
 import pytest  # noqa: E402

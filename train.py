@@ -125,7 +125,10 @@ def build_parser() -> argparse.ArgumentParser:
     synth.add_argument("--synthetic-root", default="/tmp/synthetic_coco")
     synth.add_argument("--synthetic-images", type=int, default=64)
     synth.add_argument("--synthetic-classes", type=int, default=3)
-    synth.add_argument("--synthetic-size", type=int, default=256)
+    synth.add_argument("--synthetic-size", default="256",
+                       help="source image size: N (square) or HxW — e.g. "
+                            "800x1344 generates images that land exactly in "
+                            "the flagship bucket (make convergence-full)")
 
     for sp in (coco, csvp, pascal, synth):
         # Also accepted after the subcommand; SUPPRESS so the subparser
@@ -295,7 +298,12 @@ def make_datasets(args):
         return train, val
 
     if args.dataset_type == "synthetic":
-        size = (args.synthetic_size, args.synthetic_size)
+        raw = str(args.synthetic_size)
+        if "x" in raw:
+            h, w = raw.split("x", 1)
+            size = (int(h), int(w))
+        else:
+            size = (int(raw), int(raw))
         train_ann = make_synthetic_coco(
             args.synthetic_root, num_images=args.synthetic_images,
             num_classes=args.synthetic_classes, image_size=size,

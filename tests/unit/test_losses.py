@@ -1,3 +1,5 @@
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 from batchai_retinanet_horovod_coco_tpu.losses import (
@@ -242,6 +244,42 @@ def test_nhwc_matches_concat():
     )
     for k in want:
         np.testing.assert_allclose(float(got[k]), float(want[k]), rtol=1e-5)
+
+    # GRADIENT parity: the NHWC path's focal term uses a hand-written VJP
+    # (losses._focal_nhwc_level_sums_bwd, closed-form derivative) — pin it
+    # against autodiff of the reference concatenated path.  A sign flip,
+    # a swapped d_pos/d_neg mask, or a dropped ignore mask in the custom
+    # backward keeps every forward-value test green while training
+    # silently diverges; this is the test that fails instead.
+    def loss_nhwc(cls_ls, box_ls):
+        return total_loss_compact_nhwc(
+            cls_ls, box_ls, labels, box_t, state, A_LOC
+        )["loss"]
+
+    def loss_concat(lg, bp):
+        return total_loss_compact(lg, bp, labels, box_t, state)["loss"]
+
+    g_nhwc = jax.grad(loss_nhwc, argnums=(0, 1))(
+        tuple(map(jnp.asarray, cls_levels)), tuple(map(jnp.asarray, box_levels))
+    )
+    g_concat = jax.grad(loss_concat, argnums=(0, 1))(
+        jnp.asarray(logits), jnp.asarray(box_preds)
+    )
+    off = 0
+    for i, ((h, w), n) in enumerate(zip(level_hw, level_sizes)):
+        np.testing.assert_allclose(
+            np.asarray(g_nhwc[0][i]).reshape(B, n, K),
+            np.asarray(g_concat[0][:, off : off + n]),
+            rtol=1e-5,
+            atol=1e-8,
+        )
+        np.testing.assert_allclose(
+            np.asarray(g_nhwc[1][i]).reshape(B, n, 4),
+            np.asarray(g_concat[1][:, off : off + n]),
+            rtol=1e-5,
+            atol=1e-8,
+        )
+        off += n
 
 
 def test_nhwc_size_mismatch_raises():

@@ -429,6 +429,7 @@ def total_loss_compact_nhwc(
     anchor_state: jnp.ndarray,
     anchors_per_location: int,
     config: LossConfig = LossConfig(),
+    planar_box_targets: bool = False,
 ) -> dict[str, jnp.ndarray]:
     """:func:`total_loss_compact` on RAW (B, h, w, A·K) head outputs.
 
@@ -483,11 +484,29 @@ def total_loss_compact_nhwc(
         )
 
         c4 = a_loc * 4
-        boxt_ck = (
-            box_targets[..., sl, :]
-            .reshape(*batch_shape, h, w, c4)
-            .astype(jnp.float32)
-        )
+        if planar_box_targets:
+            # (..., 4, A) planar targets: slice lanes, then one SMALL
+            # transpose (a few MB, dense tiles) into the (a, j) channel
+            # order of the head output.  The (..., A, 4) form instead
+            # retiles a 32x-lane-padded tensor (~1 ms for P3 alone,
+            # round-3 profile reshape.488).
+            boxt_ck = (
+                jnp.moveaxis(
+                    box_targets[..., sl].reshape(
+                        *batch_shape, 4, h, w, a_loc
+                    ),
+                    -4,
+                    -1,
+                )
+                .reshape(*batch_shape, h, w, c4)
+                .astype(jnp.float32)
+            )
+        else:
+            boxt_ck = (
+                box_targets[..., sl, :]
+                .reshape(*batch_shape, h, w, c4)
+                .astype(jnp.float32)
+            )
         sl1 = _smooth_l1_elementwise(box_l.astype(jnp.float32), boxt_ck, config)
         pos_ck = jnp.broadcast_to(
             positive4[..., None], (*batch_shape, h, w, a_loc, 4)

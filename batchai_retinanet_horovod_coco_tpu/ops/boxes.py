@@ -59,6 +59,45 @@ def encode_boxes(
     return (deltas - means) / stds
 
 
+def encode_boxes_planar(
+    anchors: jnp.ndarray,
+    gt_boxes: jnp.ndarray,
+    config: BoxCodecConfig = BoxCodecConfig(),
+) -> jnp.ndarray:
+    """:func:`encode_boxes` on coordinate-planar (..., 4, A) tensors.
+
+    TPU layout form: the coordinate axis rides sublanes and anchors ride the
+    128-lane minor dim, so every op runs full-lane and nothing pays the 32x
+    lane-padding tax of a 4-minor tensor (a (B, A, 4) f32 tensor at the
+    flagship bucket is 6.45 MB logical but ~206 MB as T(8,128) tiles).
+    Same arithmetic per element as :func:`encode_boxes` → identical values.
+    """
+
+    def center(b):
+        w = b[..., 2, :] - b[..., 0, :]
+        h = b[..., 3, :] - b[..., 1, :]
+        return b[..., 0, :] + 0.5 * w, b[..., 1, :] + 0.5 * h, w, h
+
+    acx, acy, aw, ah = center(anchors)
+    gcx, gcy, gw, gh = center(gt_boxes)
+    aw = jnp.maximum(aw, 1e-6)
+    ah = jnp.maximum(ah, 1e-6)
+    gw = jnp.maximum(gw, 1e-6)
+    gh = jnp.maximum(gh, 1e-6)
+    deltas = jnp.stack(
+        [
+            (gcx - acx) / aw,
+            (gcy - acy) / ah,
+            jnp.log(gw / aw),
+            jnp.log(gh / ah),
+        ],
+        axis=-2,
+    )
+    means = jnp.asarray(config.means, dtype=deltas.dtype)[:, None]
+    stds = jnp.asarray(config.stds, dtype=deltas.dtype)[:, None]
+    return (deltas - means) / stds
+
+
 def decode_boxes(
     anchors: jnp.ndarray,
     deltas: jnp.ndarray,

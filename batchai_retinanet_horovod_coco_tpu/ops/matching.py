@@ -29,7 +29,11 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from batchai_retinanet_horovod_coco_tpu.ops.boxes import BoxCodecConfig, encode_boxes
+from batchai_retinanet_horovod_coco_tpu.ops.boxes import (
+    BoxCodecConfig,
+    encode_boxes,
+    encode_boxes_planar,
+)
 from batchai_retinanet_horovod_coco_tpu.ops.iou import pairwise_iou
 
 IGNORE = -1
@@ -213,6 +217,7 @@ def anchor_targets_compact_batched(
     gt_mask: jnp.ndarray,
     matching: MatchingConfig = MatchingConfig(),
     codec: BoxCodecConfig = BoxCodecConfig(),
+    planar_box_targets: bool = False,
 ) -> CompactTargets:
     """Batched :func:`anchor_targets_compact` — the train-step entrypoint.
 
@@ -220,14 +225,28 @@ def anchor_targets_compact_batched(
     (``MatchingConfig.fused_pallas``); both produce identical targets
     (tests/unit/test_pallas_matching.py).  Inputs carry a leading batch dim
     except ``anchors`` (shared).
+
+    ``planar_box_targets``: return ``box_targets`` coordinate-planar as
+    (B, 4, A) instead of (B, A, 4).  On TPU a 4-minor f32 tensor tiles at
+    ~3% lane occupancy (206 MB of T(8,128) tiles at the flagship bucket),
+    and every op touching it — the kernel-output moveaxis, the encode, the
+    positive mask, the per-level loss retile — pays that tax; the planar
+    form is the same values in a dense layout (identical per-element
+    arithmetic, see ops.boxes.encode_boxes_planar).  The train step's NHWC
+    loss path consumes this form.
     """
     fused = matching.fused_pallas
     if fused is None:
         fused = jax.default_backend() == "tpu"
     if not fused:
-        return jax.vmap(
+        targets = jax.vmap(
             anchor_targets_compact, in_axes=(None, 0, 0, 0, None, None)
         )(anchors, gt_boxes, gt_labels, gt_mask, matching, codec)
+        if planar_box_targets:
+            targets = targets._replace(
+                box_targets=jnp.moveaxis(targets.box_targets, -1, -2)
+            )
+        return targets
 
     from batchai_retinanet_horovod_coco_tpu.ops.pallas.matching import (
         assign_fused,
@@ -237,6 +256,7 @@ def anchor_targets_compact_batched(
         assign_fused(
             anchors, gt_boxes, gt_labels, gt_mask,
             interpret=matching.pallas_interpret,
+            planar=planar_box_targets,
         )
     )
     num_anchors = anchors.shape[0]
@@ -248,9 +268,15 @@ def anchor_targets_compact_batched(
         if forced_target is not None:
             # The kernel's matched rows reflect the pre-force argmax; patch
             # the ≤G force-matched anchors with their gt's box/label.
-            mb = mb.at[forced_target].set(
-                boxes.astype(jnp.float32), mode="drop"
-            )
+            if planar_box_targets:
+                # mb is (4, A): scatter the gt coords along lanes.
+                mb = mb.at[:, forced_target].set(
+                    jnp.moveaxis(boxes.astype(jnp.float32), 0, 1), mode="drop"
+                )
+            else:
+                mb = mb.at[forced_target].set(
+                    boxes.astype(jnp.float32), mode="drop"
+                )
             ml = ml.at[forced_target].set(
                 labels.astype(jnp.int32), mode="drop"
             )
@@ -262,8 +288,14 @@ def anchor_targets_compact_batched(
     )
 
     positive = state == POSITIVE
-    box_targets = encode_boxes(anchors[None], matched_boxes, codec)
-    box_targets = jnp.where(positive[..., None], box_targets, 0.0)
+    if planar_box_targets:
+        box_targets = encode_boxes_planar(
+            jnp.moveaxis(anchors, 0, 1)[None], matched_boxes, codec
+        )
+        box_targets = jnp.where(positive[..., None, :], box_targets, 0.0)
+    else:
+        box_targets = encode_boxes(anchors[None], matched_boxes, codec)
+        box_targets = jnp.where(positive[..., None], box_targets, 0.0)
     return CompactTargets(
         matched_labels=matched_labels,
         box_targets=box_targets,

@@ -116,13 +116,14 @@ def _kernel(anchors_ref, gt_ref, packedT_ref, out_ref, gtbest_ref, *, num_anchor
         gtbest_ref[0] = jnp.where(better, update, cur)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+@functools.partial(jax.jit, static_argnames=("interpret", "planar"))
 def assign_fused(
     anchors: jnp.ndarray,
     gt_boxes: jnp.ndarray,
     gt_labels: jnp.ndarray,
     gt_mask: jnp.ndarray,
     interpret: bool = False,
+    planar: bool = False,
 ):
     """Batched fused assignment.
 
@@ -131,10 +132,15 @@ def assign_fused(
       gt_boxes: (B, G, 4) padded corner boxes.
       gt_labels: (B, G) int32.
       gt_mask: (B, G) bool.
+      planar: return matched boxes coordinate-planar (B, 4, A) — a FREE
+        slice of the kernel's transposed output, where the default (B, A, 4)
+        form costs a moveaxis copy of a 32x-lane-padded tensor (~206 MB of
+        tiles at the flagship bucket; see ops.boxes.encode_boxes_planar).
 
     Returns:
-      matched_boxes (B, A, 4) f32, matched_labels (B, A) int32,
-      max_iou (B, A) f32, gt_best_iou (B, G) f32, gt_best_anchor (B, G) int32.
+      matched_boxes (B, A, 4) f32 — or (B, 4, A) when ``planar`` —
+      matched_labels (B, A) int32, max_iou (B, A) f32, gt_best_iou (B, G)
+      f32, gt_best_anchor (B, G) int32.
     """
     batch, num_gt, _ = gt_boxes.shape
     num_anchors = anchors.shape[0]
@@ -191,7 +197,9 @@ def assign_fused(
         interpret=interpret,
     )(jnp.moveaxis(anchors.astype(jnp.float32), 0, 1), gt, packed_t)
 
-    matched_boxes = jnp.moveaxis(out[:, :4, :], 1, 2)  # (B, A, 4)
+    matched_boxes = (
+        out[:, :4, :] if planar else jnp.moveaxis(out[:, :4, :], 1, 2)
+    )
     matched_labels = out[:, 4, :].astype(jnp.int32)
     max_iou = out[:, ROW_MAX_IOU, :]
     gt_best_iou = gtbest[..., GT_COL_IOU]

@@ -73,8 +73,12 @@ def _forward_and_loss(
     # focal loss fuses the implicit one-hot (losses.focal_loss_compact).
     # Batched entrypoint: fused Pallas assignment on TPU, vmapped XLA
     # elsewhere (ops/matching.py).
+    # Planar (B, 4, A) box targets on the NHWC path: dense lane layout end
+    # to end instead of the 32x-padded 4-minor form (ops.matching docstring).
+    planar = return_levels == "nhwc"
     targets = matching_lib.anchor_targets_compact_batched(
-        anchors, gt_boxes, gt_labels, gt_mask, matching_config
+        anchors, gt_boxes, gt_labels, gt_mask, matching_config,
+        planar_box_targets=planar,
     )
     targets = jax.tree.map(lax.stop_gradient, targets)
 
@@ -87,6 +91,7 @@ def _forward_and_loss(
             targets.state,
             model.config.anchors_per_location,
             loss_config,
+            planar_box_targets=True,
         )
     else:
         metrics = losses_lib.total_loss_compact(

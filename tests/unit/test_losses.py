@@ -245,6 +245,21 @@ def test_nhwc_matches_concat():
     for k in want:
         np.testing.assert_allclose(float(got[k]), float(want[k]), rtol=1e-5)
 
+    # Planar (B, 4, A) box targets — the step's layout — same values.
+    got_planar = total_loss_compact_nhwc(
+        tuple(cls_levels),
+        tuple(box_levels),
+        labels,
+        np.moveaxis(box_t, -1, -2),
+        state,
+        A_LOC,
+        planar_box_targets=True,
+    )
+    for k in want:
+        np.testing.assert_allclose(
+            float(got_planar[k]), float(want[k]), rtol=1e-5
+        )
+
     # GRADIENT parity: the NHWC path's focal term uses a hand-written VJP
     # (losses._focal_nhwc_level_sums_bwd, closed-form derivative) — pin it
     # against autodiff of the reference concatenated path.  A sign flip,

@@ -112,3 +112,32 @@ def pl_tile():
     from batchai_retinanet_horovod_coco_tpu.ops.pallas.matching import TILE_A
 
     return TILE_A
+
+
+@pytest.mark.parametrize("config", [FUSED, JNP], ids=["fused", "jnp"])
+def test_planar_box_targets_match(config):
+    """planar_box_targets=True is the (B, A, 4) result, transposed, on BOTH
+    backends — the train step's NHWC path consumes the planar layout
+    (identical per-element arithmetic via ops.boxes.encode_boxes_planar)."""
+    anchors = jnp.asarray(A.anchors_for_image_shape((64, 64)))
+    boxes, labels, mask = _rand_scene(seed=3)
+    planar = M.anchor_targets_compact_batched(
+        anchors, boxes, labels, mask, config, planar_box_targets=True
+    )
+    plain = M.anchor_targets_compact_batched(
+        anchors, boxes, labels, mask, config
+    )
+    assert planar.box_targets.shape == (
+        plain.box_targets.shape[0], 4, plain.box_targets.shape[1]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(planar.state), np.asarray(plain.state)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(planar.matched_labels), np.asarray(plain.matched_labels)
+    )
+    np.testing.assert_allclose(
+        np.moveaxis(np.asarray(planar.box_targets), -2, -1),
+        np.asarray(plain.box_targets),
+        rtol=1e-6, atol=1e-7,
+    )

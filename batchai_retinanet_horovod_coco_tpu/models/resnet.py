@@ -68,6 +68,73 @@ class StemConv(nn.Module):
     block: int = 2
     dtype: jnp.dtype = jnp.bfloat16
 
+    # When True (and the h2w4 lowering applies), return the conv output in
+    # its native packed layout (B, H/2, W/4, (u, f)) — u = the two stride-2
+    # W outputs per block, u-MAJOR — instead of unfolding to
+    # (B, H/2, W/2, f).  The unfold is a lane retile (128 -> 64) that XLA
+    # pays as ~4 copies fwd+bwd (~5 ms/step profiled); the ResNet wiring
+    # instead runs norm/relu packed and lets the maxpool consume the packed
+    # layout directly (maxpool_packed_w).
+    packed_output: bool = False
+
+    def _h2w4(self, x: jnp.ndarray, kernel: jnp.ndarray) -> jnp.ndarray:
+        """block=2 stem computed as an H-fold-2 / W-fold-4 block conv.
+
+        Same math as the 2x2 fold (one zero-led kernel regather), but the
+        conv runs at (4, 3, 8c, 128) instead of (4, 4, 4c, 64): 24 input
+        channels / 128 output channels fill the MXU far better than 12/64,
+        which outweighs the 1.5x MAC redundancy of the wider zero-padded
+        taps.  MEASURED (v5e-1, flagship shapes, fwd+bwd in isolation):
+        4.4 ms vs 9.1 ms for the 2x2 form — and unlike the 4x4 fold
+        (measured end-to-end negative, class docstring) BOTH W-side
+        reshapes stay free: the W input fold because W-slots are
+        channel-major, and the W output unfold because the two stride-2
+        outputs of each block are emitted u-MAJOR ahead of the feature
+        channels.  Only the H fold moves data (the same single transpose
+        the 2x2 form pays).
+
+        Derivation (torch geometry, per dim: out[o] = Σ_t w[t]·x[2o+t-3]):
+        H: x row 2j+t-3 = 2(j+β)+r → t = 2β+r+3, β ∈ {-2..1} → 4 taps,
+        pad (2, 1).  W: with o = 2J+u (u ∈ {0,1} emitted as channels) and
+        x col 4(J+β)+r → t = 4β+r-2u+3, β ∈ {-1..1} → 3 taps, pad (1, 1).
+        Invalid t gathers a zero row (index 7 of the zero-padded kernel).
+        """
+        b, h, w, c_in = x.shape
+        f = self.features
+        x = x.reshape(b, h // 2, 2, w, c_in)
+        x = x.transpose(0, 1, 3, 2, 4)  # the one real data movement
+        x = x.reshape(b, h // 2, w // 4, 8 * c_in)  # (p_w, p_h, c): free
+        dy = jnp.arange(4)
+        rh = jnp.arange(2)
+        t_h = 2 * (dy[:, None] - 2) + rh[None, :] + 3  # (dy, rh)
+        dx = jnp.arange(3)
+        rw = jnp.arange(4)
+        u = jnp.arange(2)
+        t_w = (
+            4 * (dx[:, None, None] - 1)
+            + rw[None, :, None]
+            - 2 * u[None, None, :]
+            + 3
+        )  # (dx, rw, u)
+        t_h = jnp.where((t_h >= 0) & (t_h <= 6), t_h, 7)
+        t_w = jnp.where((t_w >= 0) & (t_w <= 6), t_w, 7)
+        kp = jnp.pad(kernel, ((0, 1), (0, 1), (0, 0), (0, 0)))  # (8, 8, c, f)
+        kg = kp[
+            t_h[:, :, None, None, None], t_w[None, None, :, :, :]
+        ]  # (dy, rh, dx, rw, u, c, f)
+        kg = kg.transpose(0, 2, 3, 1, 5, 4, 6)  # (dy, dx, rw, rh, c, u, f)
+        k2 = kg.reshape(4, 3, 8 * c_in, 2 * f)
+        y = lax.conv_general_dilated(
+            x,
+            k2.astype(self.dtype),
+            window_strides=(1, 1),
+            padding=((2, 1), (1, 1)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )  # (b, h/2, w/4, (u, f))
+        if self.packed_output:
+            return y
+        return y.reshape(b, h // 2, w // 2, f)  # W unfold (lane retile)
+
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         x = x.astype(self.dtype)
@@ -98,6 +165,13 @@ class StemConv(nn.Module):
             raise ValueError(
                 f"space_to_depth({self.block}) stem needs H, W divisible by "
                 f"{self.block}; got {(h, w)}"
+            )
+        if self.block == 2 and w % 4 == 0:
+            return self._h2w4(x, kernel)
+        if self.packed_output:
+            raise ValueError(
+                "packed_output requires the h2w4 lowering "
+                f"(block=2 and W % 4 == 0; got block={self.block}, W={w})"
             )
         # Input: fold block x block pixel tiles into channels.  Channel order
         # is (p_w, p_h, c) — W-slot MAJOR — because that order makes the W
@@ -282,14 +356,16 @@ class PackedGroupNorm(nn.Module):
     """GroupNorm(32) on the packed layout, exact w.r.t. the unpacked op.
 
     Stats for a logical-channel group must pool BOTH w slots of its
-    channels; the (c, u) packing keeps those contiguous, so this is the
-    plain group reshape with the slot axis folded into the group.
+    channels.  ``slot_major`` selects the packing order: False = (c, u)
+    channel-major (the pack_width stage layout), True = (u, c) slot-major
+    (the h2w4 packed stem layout) — same math, different unpack reshape.
     Params are the logical (C,) scale/bias — same tree as ``nn.GroupNorm``.
     """
 
     num_groups: int = 32
     epsilon: float = 1e-6
     dtype: jnp.dtype = jnp.bfloat16
+    slot_major: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -298,14 +374,18 @@ class PackedGroupNorm(nn.Module):
         scale = self.param("scale", nn.initializers.ones_init(), (c,), jnp.float32)
         bias = self.param("bias", nn.initializers.zeros_init(), (c,), jnp.float32)
         g = self.num_groups
-        xf = x.astype(jnp.float32).reshape(b, h, wp, g, c // g, 2)
-        mean = xf.mean(axis=(1, 2, 4, 5), keepdims=True)
+        xf = x.astype(jnp.float32)
+        if self.slot_major:
+            xf = xf.reshape(b, h, wp, 2, g, c // g)
+            pool_axes, aff = (1, 2, 3, 5), (1, 1, 1, 1, g, c // g)
+        else:
+            xf = xf.reshape(b, h, wp, g, c // g, 2)
+            pool_axes, aff = (1, 2, 4, 5), (1, 1, 1, g, c // g, 1)
+        mean = xf.mean(axis=pool_axes, keepdims=True)
         # use_fast_variance formula, as flax GroupNorm computes it.
-        var = (xf * xf).mean(axis=(1, 2, 4, 5), keepdims=True) - mean * mean
+        var = (xf * xf).mean(axis=pool_axes, keepdims=True) - mean * mean
         y = (xf - mean) * lax.rsqrt(var + self.epsilon)
-        y = y * scale.reshape(1, 1, 1, g, c // g, 1) + bias.reshape(
-            1, 1, 1, g, c // g, 1
-        )
+        y = y * scale.reshape(aff) + bias.reshape(aff)
         return y.reshape(b, h, wp, c2).astype(self.dtype)
 
 
@@ -315,13 +395,15 @@ class PackedBatchNorm(nn.Module):
     Batch statistics pool over (B, H, Wp, slot) — exactly the unpacked
     (B, H, W) reduction.  ``use_running_average`` covers both frozen_bn
     (always) and plain bn at eval; train-mode bn updates the running stats
-    with the same 0.9 momentum as the unpacked layer.
+    with the same 0.9 momentum as the unpacked layer.  ``slot_major`` as
+    in :class:`PackedGroupNorm`.
     """
 
     use_running_average: bool
     momentum: float = 0.9
     epsilon: float = 1e-5
     dtype: jnp.dtype = jnp.bfloat16
+    slot_major: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -335,12 +417,18 @@ class PackedBatchNorm(nn.Module):
         ra_var = self.variable(
             "batch_stats", "var", lambda: jnp.ones((c,), jnp.float32)
         )
-        xf = x.astype(jnp.float32).reshape(b, h, wp, c, 2)
+        xf = x.astype(jnp.float32)
+        if self.slot_major:
+            xf = xf.reshape(b, h, wp, 2, c)
+            pool_axes, chan = (0, 1, 2, 3), slice(None)
+        else:
+            xf = xf.reshape(b, h, wp, c, 2)
+            pool_axes, chan = (0, 1, 2, 4), (slice(None), None)
         if self.use_running_average:
             mean, var = ra_mean.value, ra_var.value
         else:
-            mean = xf.mean(axis=(0, 1, 2, 4))
-            var = (xf * xf).mean(axis=(0, 1, 2, 4)) - mean * mean
+            mean = xf.mean(axis=pool_axes)
+            var = (xf * xf).mean(axis=pool_axes) - mean * mean
             if not self.is_initializing():
                 ra_mean.value = (
                     self.momentum * ra_mean.value + (1 - self.momentum) * mean
@@ -348,9 +436,60 @@ class PackedBatchNorm(nn.Module):
                 ra_var.value = (
                     self.momentum * ra_var.value + (1 - self.momentum) * var
                 )
-        y = (xf - mean[:, None]) * lax.rsqrt(var[:, None] + self.epsilon)
-        y = y * scale[:, None] + bias[:, None]
+        y = (xf - mean[chan]) * lax.rsqrt(var[chan] + self.epsilon)
+        y = y * scale[chan] + bias[chan]
         return y.reshape(b, h, wp, c2).astype(self.dtype)
+
+
+# --- Packed-stem maxpool ----------------------------------------------------
+#
+# The h2w4 stem emits (B, H/2, W/4, (u, f)) with the W slot u MAJOR (that is
+# what makes its kernel fold free); PackedGroupNorm/PackedBatchNorm handle
+# that order via slot_major=True, and maxpool_packed_w consumes the packed
+# layout directly so the 128->64 lane retile of an explicit unfold never
+# happens.
+
+
+def maxpool_packed_w(x: jnp.ndarray) -> jnp.ndarray:
+    """3x3/s2 maxpool with (1, 1) -inf padding, consuming the u-major
+    packed stem layout and emitting the UNPACKED pooled tensor.
+
+    H first: a native 3x1/s2 reduce_window on the packed tensor (its VJP
+    is the efficient 1-D select_and_scatter).  Then W on the QUARTER-SIZE
+    result: logical cols w = 2J + u, and pooled col o reads w in
+    {2o-1, 2o, 2o+1} = (J=o-1, u=1), (J=o, u=0), (J=o, u=1) — two channel
+    halves plus one shifted slice (lax.pad with a negative edge), pure
+    lane ops.  Forward matches
+    ``nn.max_pool(x_unfolded, (3, 3), (2, 2), ((1, 1), (1, 1)))`` exactly
+    (pinned by a unit test).
+
+    Backward is plain autodiff: first-max rows along H, JAX's half/half
+    tie split along W — a deliberate, documented subgradient divergence
+    from the 2-D select_and_scatter's row-major first-max (ties only;
+    both are valid, deterministic, and identical across shards).  The
+    exact-routing custom VJP was measured SLOWER either way it was
+    decomposed (W-first: ~4 ms/step of select traffic at full height);
+    this H-first form measured 6.2 ms vs 6.6 for the unpacked
+    nn.max_pool fwd+bwd in isolation at the flagship bucket.
+    """
+    y = lax.reduce_window(
+        x,
+        -jnp.inf,
+        lax.max,
+        (1, 3, 1, 1),
+        (1, 2, 1, 1),
+        ((0, 0), (1, 1), (0, 0), (0, 0)),
+    )  # (b, h/2 -> h/4 rows, w4, (u, f))
+    f = y.shape[-1] // 2
+    u0 = y[..., :f]
+    u1 = y[..., f:]
+    # Shift right one block: u1_left[J] = u1[J-1], -inf into the new col.
+    u1_left = lax.pad(
+        u1,
+        jnp.asarray(-jnp.inf, y.dtype),
+        ((0, 0, 0), (0, 0, 0), (1, -1, 0), (0, 0, 0)),
+    )
+    return jnp.maximum(jnp.maximum(u1_left, u0), u1)
 
 
 class NormFactory:
@@ -376,13 +515,18 @@ class NormFactory:
             name=name,
         )
 
-    def packed(self, name: str, train: bool) -> Callable:
-        """The same norm, applied on the width-packed layout (same params)."""
+    def packed(self, name: str, train: bool, slot_major: bool = False) -> Callable:
+        """The same norm, applied on a width-packed layout (same params)."""
         if self.kind == "gn":
-            return PackedGroupNorm(dtype=self.dtype, name=name)
+            return PackedGroupNorm(
+                dtype=self.dtype, slot_major=slot_major, name=name
+            )
         use_running = (self.kind == "frozen_bn") or (not train)
         return PackedBatchNorm(
-            use_running_average=use_running, dtype=self.dtype, name=name
+            use_running_average=use_running,
+            dtype=self.dtype,
+            slot_major=slot_major,
+            name=name,
         )
 
 
@@ -453,20 +597,35 @@ class ResNet(nn.Module):
             raise ValueError(f"unknown stem: {self.stem!r}")
         norm = NormFactory(self.norm_kind, self.dtype)
         x = x.astype(self.dtype)
+        # The h2w4 stem lowering keeps its output packed (B, H/2, W/4,
+        # (u, f)) and norm/relu/maxpool consume that layout: unfolding
+        # first costs a 128->64 lane retile XLA pays as ~4 full copies
+        # fwd+bwd (~5 ms/step profiled at the flagship bucket).
+        packed_stem = (
+            self.stem == "space_to_depth"
+            and x.shape[1] % 2 == 0
+            and x.shape[2] % 4 == 0
+        )
         x = StemConv(
             features=64,
             space_to_depth=self.stem != "conv",
             block=4 if self.stem == "space_to_depth4" else 2,
             dtype=self.dtype,
+            packed_output=packed_stem,
             name="stem_conv",
         )(x)
-        x = norm("stem_norm", train)(x)
-        x = nn.relu(x)
-        # Symmetric (1, 1) padding (torch geometry; SAME would pad (0, 1)
-        # on even dims).  -inf pad so padding never wins the max.
-        x = nn.max_pool(
-            x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1))
-        )
+        if packed_stem:
+            x = norm.packed("stem_norm", train, slot_major=True)(x)
+            x = nn.relu(x)
+            x = maxpool_packed_w(x)
+        else:
+            x = norm("stem_norm", train)(x)
+            x = nn.relu(x)
+            # Symmetric (1, 1) padding (torch geometry; SAME would pad
+            # (0, 1) on even dims).  -inf pad so padding never wins the max.
+            x = nn.max_pool(
+                x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1))
+            )
 
         features: dict[str, jnp.ndarray] = {}
         filters = 64

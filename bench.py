@@ -25,10 +25,11 @@ import numpy as np
 import optax
 
 BUCKET = (800, 1344)
-WARMUP_STEPS = 3
-# 20 steps ≈ 2.7 s of device time: enough to amortize the one hard
-# host-sync (a tunnel round trip) to <0.3% of the measurement.
-MEASURE_STEPS = 20
+WARMUP_STEPS = 5
+# 60 steps ≈ 7.5 s of device time: the tunnel's per-step dispatch jitter
+# showed up as ±1 imgs/s run-to-run at 20 steps (round 3); tripling the
+# window cuts that to ~±0.3 while keeping the whole bench under a minute.
+MEASURE_STEPS = 60
 
 # Peak dense bf16 TFLOP/s per chip by device kind (public spec sheets);
 # used only to report MFU next to the throughput number.

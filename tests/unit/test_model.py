@@ -216,3 +216,87 @@ class TestSpaceToDepthStem:
             np.asarray(a["box_deltas"]), np.asarray(b["box_deltas"]),
             rtol=1e-4, atol=1e-4,
         )
+
+
+class TestPackedStemPipeline:
+    """The h2w4 packed stem pipeline (StemConv packed_output + slot-packed
+    norm + maxpool_packed_w) must reproduce the unpacked backbone exactly."""
+
+    def test_maxpool_packed_w_matches_unpacked(self):
+        from batchai_retinanet_horovod_coco_tpu.models.resnet import (
+            maxpool_packed_w,
+        )
+        import flax.linen as nn
+
+        rng = np.random.default_rng(0)
+        # Quantized relu-like values: dense max ties, the realistic regime.
+        x = jnp.asarray(
+            np.maximum(rng.integers(-2, 4, (2, 16, 24, 8)), 0).astype(
+                np.float32
+            )
+        )
+        want = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        b, h, w, f = x.shape
+        xf = x.reshape(b, h, w // 2, 2, f)
+        packed = jnp.concatenate([xf[:, :, :, 0], xf[:, :, :, 1]], axis=-1)
+        got = maxpool_packed_w(packed)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        # Gradients are finite and conserve the cotangent mass (the W tie
+        # rule deliberately diverges — maxpool_packed_w docstring — but a
+        # routing bug that dropped or duplicated mass would break this).
+        g = jax.grad(lambda p: jnp.sum(maxpool_packed_w(p) ** 2))(packed)
+        assert bool(jnp.all(jnp.isfinite(g)))
+        np.testing.assert_allclose(
+            float(jnp.sum(g)),
+            float(jnp.sum(2.0 * maxpool_packed_w(packed))),
+            rtol=1e-6,
+        )
+
+    @pytest.mark.parametrize("norm", ["frozen_bn", "gn", "bn"])
+    @pytest.mark.parametrize("hw", [(64, 96), (32, 100), (32, 46)])
+    def test_backbone_matches_conv_stem(self, norm, hw):
+        """Full ResNet: s2d (packed h2w4 where W%4==0, h2w2 fallback
+        otherwise) == conv stem with shared params."""
+        from batchai_retinanet_horovod_coco_tpu.models.resnet import ResNet
+
+        rng = np.random.default_rng(0)
+        h, w = hw
+        x = jnp.asarray(rng.normal(0, 1, (2, h, w, 3)).astype(np.float32))
+        ref = ResNet(
+            stage_sizes=(1, 1, 1, 1), norm_kind=norm, dtype=jnp.float32,
+            stem="conv",
+        )
+        v = ref.init(jax.random.key(0), x, train=False)
+        s2d = ResNet(
+            stage_sizes=(1, 1, 1, 1), norm_kind=norm, dtype=jnp.float32,
+            stem="space_to_depth",
+        )
+        y_ref = jax.jit(lambda v, x: ref.apply(v, x, train=False))(v, x)
+        y_s2d = jax.jit(lambda v, x: s2d.apply(v, x, train=False))(v, x)
+        for k in y_ref:
+            np.testing.assert_allclose(
+                np.asarray(y_ref[k]), np.asarray(y_s2d[k]),
+                rtol=1e-4, atol=2e-5,
+            )
+
+    def test_train_mode_bn_stats_match(self):
+        """Slot-major PackedBatchNorm running-stat updates == nn.BatchNorm."""
+        from batchai_retinanet_horovod_coco_tpu.models.resnet import ResNet
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(0, 1, (2, 64, 96, 3)).astype(np.float32))
+        ref = ResNet(
+            stage_sizes=(1, 1, 1, 1), norm_kind="bn", dtype=jnp.float32,
+            stem="conv",
+        )
+        v = ref.init(jax.random.key(0), x, train=True)
+        s2d = ResNet(
+            stage_sizes=(1, 1, 1, 1), norm_kind="bn", dtype=jnp.float32,
+            stem="space_to_depth",
+        )
+        _, m_ref = ref.apply(v, x, train=True, mutable=["batch_stats"])
+        _, m_s2d = s2d.apply(v, x, train=True, mutable=["batch_stats"])
+        for a, b in zip(jax.tree.leaves(m_ref), jax.tree.leaves(m_s2d)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            )

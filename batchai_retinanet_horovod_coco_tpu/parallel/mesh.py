@@ -19,6 +19,10 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 DATA_AXIS = "data"
+# Second mesh axis for spatial (image-H) partitioning — the training-side
+# sequence/context-parallel analogue (train.step.make_train_step_spatial,
+# evaluate.detect.make_detect_fn_spatial).
+SPACE_AXIS = "space"
 
 
 def make_mesh(num_devices: int | None = None) -> Mesh:
@@ -31,6 +35,22 @@ def make_mesh(num_devices: int | None = None) -> Mesh:
             )
         devices = devices[:num_devices]
     return Mesh(np.asarray(devices), axis_names=(DATA_AXIS,))
+
+
+def make_mesh_2d(num_data: int, num_space: int) -> Mesh:
+    """2-D (data, space) mesh: batch over ``data``, image H over ``space``.
+
+    Lay the space axis minor so each image's H shards sit on
+    ICI-adjacent chips — the halo exchanges GSPMD inserts for spatially
+    partitioned convs are neighbor traffic, exactly like ring attention's
+    boundary passes.
+    """
+    devices = jax.devices()
+    n = num_data * num_space
+    if n > len(devices):
+        raise ValueError(f"requested {n} devices, have {len(devices)}")
+    grid = np.asarray(devices[:n]).reshape(num_data, num_space)
+    return Mesh(grid, axis_names=(DATA_AXIS, SPACE_AXIS))
 
 
 def make_local_mesh() -> Mesh:

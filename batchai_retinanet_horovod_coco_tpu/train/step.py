@@ -24,14 +24,14 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from batchai_retinanet_horovod_coco_tpu import losses as losses_lib
 from batchai_retinanet_horovod_coco_tpu.data import pipeline as pipeline_lib
 from batchai_retinanet_horovod_coco_tpu.ops import anchors as anchors_lib
 from batchai_retinanet_horovod_coco_tpu.ops import matching as matching_lib
-from batchai_retinanet_horovod_coco_tpu.parallel.mesh import DATA_AXIS
+from batchai_retinanet_horovod_coco_tpu.parallel.mesh import DATA_AXIS, SPACE_AXIS
 from batchai_retinanet_horovod_coco_tpu.train.state import TrainState, model_variables
 
 
@@ -108,6 +108,51 @@ def _forward_and_loss(
     return metrics["loss"], (metrics, new_batch_stats)
 
 
+def _make_local_step(model, anchors, loss_config, matching_config):
+    """The per-shard (or single-device) grad computation every step shares."""
+
+    def local_step(state: TrainState, batch: dict[str, Any]):
+        (_, (metrics, new_bs)), grads = jax.value_and_grad(
+            lambda p: _forward_and_loss(
+                model, state, p,
+                batch["images"], batch["gt_boxes"], batch["gt_labels"],
+                batch["gt_mask"], anchors, loss_config,
+                matching_config, train=True,
+            ),
+            has_aux=True,
+        )(state.params)
+        return grads, metrics, new_bs
+
+    return local_step
+
+
+def _global_math_step(local_step):
+    """Plain global-batch step body: grads → metrics → update.
+
+    Serves both the single-device step (jit) and the spatially partitioned
+    step (jit + sharding constraints, where GSPMD turns the global
+    reductions into collectives) — ONE definition so metrics/update changes
+    cannot drift between them.
+    """
+
+    def train_step(state: TrainState, batch: dict[str, Any]):
+        grads, metrics, new_bs = local_step(state, batch)
+        # SURVEY.md §5.5: grad-norm is a first-class per-step metric.
+        metrics["grad_norm"] = optax.global_norm(grads)
+        new_state = state.apply_gradients(
+            grads, new_bs, loss_value=metrics["loss"]
+        )
+        # Norm of the POST-update params: the loss above was computed
+        # from the pre-update params, so it cannot witness a poisoned
+        # update — this can, and the loop checks it before any
+        # checkpoint save (a norm read of params the next step reloads
+        # anyway; cost is noise).
+        metrics["param_norm"] = optax.global_norm(new_state.params)
+        return new_state, metrics
+
+    return train_step
+
+
 def make_train_step(
     model,
     image_hw: tuple[int, int],
@@ -161,37 +206,13 @@ def make_train_step(
         anchors_lib.anchors_for_image_shape(image_hw, anchor_config or anchors_lib.AnchorConfig())
     )
 
-    def local_step(state: TrainState, batch: dict[str, Any]):
-        (_, (metrics, new_bs)), grads = jax.value_and_grad(
-            lambda p: _forward_and_loss(
-                model, state, p,
-                batch["images"], batch["gt_boxes"], batch["gt_labels"],
-                batch["gt_mask"], anchors, loss_config,
-                matching_config, train=True,
-            ),
-            has_aux=True,
-        )(state.params)
-        return grads, metrics, new_bs
+    local_step = _make_local_step(model, anchors, loss_config, matching_config)
 
     if mesh is None:
-
-        @partial(jax.jit, donate_argnums=(0,) if donate_state else ())
-        def train_step(state: TrainState, batch: dict[str, Any]):
-            grads, metrics, new_bs = local_step(state, batch)
-            # SURVEY.md §5.5: grad-norm is a first-class per-step metric.
-            metrics["grad_norm"] = optax.global_norm(grads)
-            new_state = state.apply_gradients(
-                grads, new_bs, loss_value=metrics["loss"]
-            )
-            # Norm of the POST-update params: the loss above was computed
-            # from the pre-update params, so it cannot witness a poisoned
-            # update — this can, and the loop checks it before any
-            # checkpoint save (a norm read of params the next step reloads
-            # anyway; cost is noise).
-            metrics["param_norm"] = optax.global_norm(new_state.params)
-            return new_state, metrics
-
-        return train_step
+        return jax.jit(
+            _global_math_step(local_step),
+            donate_argnums=(0,) if donate_state else (),
+        )
 
     batch_spec = {k: P(DATA_AXIS) for k in ("images", "gt_boxes", "gt_labels", "gt_mask")}
 
@@ -304,6 +325,72 @@ def make_train_step(
         return new_state, metrics
 
     return jax.jit(sharded_step, donate_argnums=(0,) if donate_state else ())
+
+
+def make_train_step_spatial(
+    model,
+    image_hw: tuple[int, int],
+    num_classes: int,
+    mesh: Mesh,
+    loss_config: losses_lib.LossConfig = losses_lib.LossConfig(),
+    matching_config: matching_lib.MatchingConfig = matching_lib.MatchingConfig(),
+    anchor_config: anchors_lib.AnchorConfig | None = None,
+    donate_state: bool = True,
+    spatial_axis: str = SPACE_AXIS,
+) -> Callable[[TrainState, dict[str, Any]], tuple[TrainState, dict[str, jnp.ndarray]]]:
+    """Train step with the IMAGE sharded across chips (spatial partitioning).
+
+    The training-side analogue of sequence/context parallelism
+    (SURVEY.md §5.7, same idea as ``evaluate.detect.make_detect_fn_spatial``):
+    the batch shards over ``data`` AND each image's H axis shards over
+    ``spatial_axis``, so a 2-D mesh trains images too large (or batches too
+    small) for pure DP.  Built with ``jit`` + sharding constraints, not
+    ``shard_map``: spatially partitioned convs need GSPMD's halo-exchange
+    machinery — ring-attention's "pass the boundary" pattern, compiled
+    automatically — which per-device code would have to hand-roll.
+
+    The step body is the plain single-device global-batch math (no
+    explicit pmean): under GSPMD the compiler partitions the forward,
+    inserts the halos, and turns the global loss/gradient reductions into
+    the right collectives.  Gradients therefore match the DP
+    ``shard_map`` step up to f32 reduction order (pinned by a test on the
+    virtual CPU mesh).
+
+    Pallas kernels are opaque to GSPMD and cannot be spatially
+    partitioned: the fused assignment is forced off (the vmapped XLA
+    matching path partitions fine) and a ``pallas_focal`` loss config is
+    rejected rather than silently replicated.
+    """
+    import dataclasses as _dc
+
+    if loss_config.pallas_focal:
+        raise ValueError(
+            "pallas_focal is incompatible with spatial partitioning: a "
+            "pallas_call is opaque to GSPMD, so the head outputs would be "
+            "replicated instead of sharded — use the default XLA focal path"
+        )
+    matching_config = _dc.replace(matching_config, fused_pallas=False)
+    anchors = jnp.asarray(
+        anchors_lib.anchors_for_image_shape(
+            image_hw, anchor_config or anchors_lib.AnchorConfig()
+        )
+    )
+    train_step = _global_math_step(
+        _make_local_step(model, anchors, loss_config, matching_config)
+    )
+
+    rep = NamedSharding(mesh, P())
+    img = NamedSharding(mesh, P(DATA_AXIS, spatial_axis))  # B over data, H over space
+    gt = NamedSharding(mesh, P(DATA_AXIS))
+    batch_shardings = {
+        "images": img, "gt_boxes": gt, "gt_labels": gt, "gt_mask": gt
+    }
+    return jax.jit(
+        train_step,
+        in_shardings=(rep, batch_shardings),
+        out_shardings=(rep, rep),
+        donate_argnums=(0,) if donate_state else (),
+    )
 
 
 def make_eval_forward(

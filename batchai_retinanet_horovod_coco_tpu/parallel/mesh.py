@@ -70,6 +70,15 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec(DATA_AXIS))
 
 
+def spatial_batch_shardings(mesh: Mesh) -> dict[str, NamedSharding]:
+    """Per-key batch shardings for the 2-D (data, space) mesh: images shard
+    batch over ``data`` AND image-H over ``space``; the per-image gt
+    tensors shard over ``data`` only (replicated across the space axis)."""
+    img = NamedSharding(mesh, PartitionSpec(DATA_AXIS, SPACE_AXIS))
+    gt = NamedSharding(mesh, PartitionSpec(DATA_AXIS))
+    return {"images": img, "gt_boxes": gt, "gt_labels": gt, "gt_mask": gt}
+
+
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     """Fully replicated (params, optimizer state, scalars)."""
     return NamedSharding(mesh, PartitionSpec())

@@ -23,12 +23,17 @@ from batchai_retinanet_horovod_coco_tpu import losses as losses_lib
 from batchai_retinanet_horovod_coco_tpu.data.pipeline import Batch
 from batchai_retinanet_horovod_coco_tpu.ops import matching as matching_lib
 from batchai_retinanet_horovod_coco_tpu.parallel.mesh import (
+    SPACE_AXIS,
     batch_sharding,
     replicated_sharding,
+    spatial_batch_shardings,
 )
 from batchai_retinanet_horovod_coco_tpu.train import optim
 from batchai_retinanet_horovod_coco_tpu.train.state import TrainState
-from batchai_retinanet_horovod_coco_tpu.train.step import make_train_step
+from batchai_retinanet_horovod_coco_tpu.train.step import (
+    make_train_step,
+    make_train_step_spatial,
+)
 from batchai_retinanet_horovod_coco_tpu.utils.checkpoint import CheckpointManager
 from batchai_retinanet_horovod_coco_tpu.utils.metrics import MetricLogger
 
@@ -94,11 +99,19 @@ def _device_batch(batch: Batch, mesh: Mesh | None) -> dict[str, Any]:
     }
     if mesh is None:
         return {k: jax.device_put(v) for k, v in arrays.items()}
-    sharding = batch_sharding(mesh)
+    if SPACE_AXIS in mesh.axis_names:
+        # 2-D spatial mesh: images additionally shard H over `space`
+        # (train.step.make_train_step_spatial).
+        shardings = spatial_batch_shardings(mesh)
+    else:
+        s = batch_sharding(mesh)
+        shardings = {k: s for k in arrays}
     if jax.process_count() == 1:
-        return {k: jax.device_put(v, sharding) for k, v in arrays.items()}
+        return {
+            k: jax.device_put(v, shardings[k]) for k, v in arrays.items()
+        }
     return {
-        k: jax.make_array_from_process_local_data(sharding, v)
+        k: jax.make_array_from_process_local_data(shardings[k], v)
         for k, v in arrays.items()
     }
 
@@ -147,7 +160,17 @@ def run_training(
     ``eval_fn(state) -> metrics`` is the CocoEval-callback equivalent, called
     every ``eval_every`` steps and at the end.  One train step is compiled
     per (H, W) shape bucket seen in the stream.
+
+    A 2-D mesh carrying a ``space`` axis selects the spatially partitioned
+    step (image-H sharding; train/step.py::make_train_step_spatial) —
+    exclusive with the ZeRO and quantized-allreduce flavors.
     """
+    spatial = mesh is not None and SPACE_AXIS in mesh.axis_names
+    if spatial and (shard_weight_update or quantized_allreduce):
+        raise ValueError(
+            "spatial partitioning is exclusive with --shard-weight-update "
+            "and --quantized-allreduce"
+        )
     logger = logger or MetricLogger(log_dir=None)
     ckpt = None
     if config.checkpoint_every and config.checkpoint_dir:
@@ -244,17 +267,28 @@ def run_training(
         hw = images_shape[1:3]
         step_fn = step_fns.get(hw)
         if step_fn is None:
-            step_fn = step_fns[hw] = make_train_step(
-                model,
-                hw,
-                num_classes,
-                mesh=mesh,
-                loss_config=loss_config,
-                matching_config=matching_config,
-                anchor_config=anchor_config,
-                shard_weight_update=shard_weight_update,
-                quantized_allreduce=quantized_allreduce,
-            )
+            if spatial:
+                step_fn = step_fns[hw] = make_train_step_spatial(
+                    model,
+                    hw,
+                    num_classes,
+                    mesh=mesh,
+                    loss_config=loss_config,
+                    matching_config=matching_config,
+                    anchor_config=anchor_config,
+                )
+            else:
+                step_fn = step_fns[hw] = make_train_step(
+                    model,
+                    hw,
+                    num_classes,
+                    mesh=mesh,
+                    loss_config=loss_config,
+                    matching_config=matching_config,
+                    anchor_config=anchor_config,
+                    shard_weight_update=shard_weight_update,
+                    quantized_allreduce=quantized_allreduce,
+                )
         if config.profile_dir and step == prof_start:
             jax.profiler.start_trace(config.profile_dir)
         state, metrics = step_fn(state, device_arrays)

@@ -31,7 +31,7 @@ from batchai_retinanet_horovod_coco_tpu import losses as losses_lib
 from batchai_retinanet_horovod_coco_tpu.data import pipeline as pipeline_lib
 from batchai_retinanet_horovod_coco_tpu.ops import anchors as anchors_lib
 from batchai_retinanet_horovod_coco_tpu.ops import matching as matching_lib
-from batchai_retinanet_horovod_coco_tpu.parallel.mesh import DATA_AXIS, SPACE_AXIS
+from batchai_retinanet_horovod_coco_tpu.parallel.mesh import DATA_AXIS
 from batchai_retinanet_horovod_coco_tpu.train.state import TrainState, model_variables
 
 
@@ -336,7 +336,6 @@ def make_train_step_spatial(
     matching_config: matching_lib.MatchingConfig = matching_lib.MatchingConfig(),
     anchor_config: anchors_lib.AnchorConfig | None = None,
     donate_state: bool = True,
-    spatial_axis: str = SPACE_AXIS,
 ) -> Callable[[TrainState, dict[str, Any]], tuple[TrainState, dict[str, jnp.ndarray]]]:
     """Train step with the IMAGE sharded across chips (spatial partitioning).
 
@@ -379,12 +378,14 @@ def make_train_step_spatial(
         _make_local_step(model, anchors, loss_config, matching_config)
     )
 
+    from batchai_retinanet_horovod_coco_tpu.parallel.mesh import (
+        spatial_batch_shardings,
+    )
+
     rep = NamedSharding(mesh, P())
-    img = NamedSharding(mesh, P(DATA_AXIS, spatial_axis))  # B over data, H over space
-    gt = NamedSharding(mesh, P(DATA_AXIS))
-    batch_shardings = {
-        "images": img, "gt_boxes": gt, "gt_labels": gt, "gt_mask": gt
-    }
+    # ONE definition of the batch layout, shared with the loop's
+    # _device_batch placement (parallel/mesh.py).
+    batch_shardings = spatial_batch_shardings(mesh)
     return jax.jit(
         train_step,
         in_shardings=(rep, batch_shardings),

@@ -237,6 +237,13 @@ def build_parser() -> argparse.ArgumentParser:
                             "all-reduce: ~5/8 the ICI traffic, error "
                             "bounded by one rounding of the reduced "
                             "gradient (SURVEY.md §5.8, parallel/quantize.py)")
+        g.add_argument("--spatial-shards", type=int, default=1,
+                       help="shard every image's H axis over this many "
+                            "chips on a 2-D data x space mesh (GSPMD conv "
+                            "halo exchanges — the sequence/context-parallel "
+                            "analogue, SURVEY.md §5.7); must divide "
+                            "--num-devices; exclusive with "
+                            "--shard-weight-update/--quantized-allreduce")
         g.add_argument("--distributed-auto", action="store_true",
                        help="jax.distributed.initialize() from TPU metadata")
         g.add_argument("--coordinator-address", default=None)
@@ -390,10 +397,33 @@ def main(argv=None) -> dict[str, float]:
         )
     )
     num_devices = args.num_devices or len(jax.devices())
-    mesh = make_mesh(num_devices) if num_devices > 1 else None
-    if args.batch_size % num_devices:
+    spatial_shards = int(getattr(args, "spatial_shards", 1) or 1)
+    if spatial_shards > 1:
+        if num_devices % spatial_shards:
+            raise SystemExit(
+                f"--spatial-shards {spatial_shards} must divide "
+                f"--num-devices {num_devices}"
+            )
+        if getattr(args, "shard_weight_update", False) or getattr(
+            args, "quantized_allreduce", False
+        ):
+            raise SystemExit(
+                "--spatial-shards is exclusive with --shard-weight-update "
+                "and --quantized-allreduce"
+            )
+        from batchai_retinanet_horovod_coco_tpu.parallel.mesh import (
+            make_mesh_2d,
+        )
+
+        data_size = num_devices // spatial_shards
+        mesh = make_mesh_2d(data_size, spatial_shards)
+    else:
+        data_size = num_devices
+        mesh = make_mesh(num_devices) if num_devices > 1 else None
+    if args.batch_size % data_size:
         raise SystemExit(
-            f"--batch-size {args.batch_size} not divisible by {num_devices} devices"
+            f"--batch-size {args.batch_size} not divisible by the data-mesh "
+            f"size {data_size}"
         )
 
     train_ds, val_ds = make_datasets(args)
@@ -561,6 +591,31 @@ def main(argv=None) -> dict[str, float]:
         else:
             eval_mesh = mesh
             eval_batch = args.batch_size
+            from batchai_retinanet_horovod_coco_tpu.parallel.mesh import (
+                SPACE_AXIS,
+            )
+
+            if mesh is not None and SPACE_AXIS in mesh.axis_names:
+                # Eval is batch-parallel: flatten the 2-D train mesh so the
+                # space-axis chips do real work instead of replaying the
+                # data rows' detection pass (detect shards over `data`
+                # only).  Round the eval batch up to the flat mesh size.
+                from jax.sharding import Mesh as _Mesh
+
+                from batchai_retinanet_horovod_coco_tpu.parallel.mesh import (
+                    DATA_AXIS,
+                    replicated_sharding,
+                )
+
+                eval_mesh = _Mesh(
+                    mesh.devices.reshape(-1), axis_names=(DATA_AXIS,)
+                )
+                n = eval_mesh.size
+                eval_batch = ((args.batch_size + n - 1) // n) * n
+                eval_state = eval_state.replace(opt_state=())
+                eval_state = jax.device_put(
+                    eval_state, replicated_sharding(eval_mesh)
+                )
         val_batches = build_pipeline(
             val_ds,
             PipelineConfig(

@@ -351,19 +351,36 @@ def _nhwc_masks(
             (state4 != matching.IGNORE)[..., None], (*lead, a_loc, k)
         ).reshape(*lead, ck)
         return t_ck, ni_ck
+    return _masks_from_encode(_nhwc_encode(labels4, state4, k), k)
+
+
+def _nhwc_encode(
+    labels4: jnp.ndarray, state4: jnp.ndarray, k: int
+) -> jnp.ndarray:
+    """The encoded-target matmul broadcast: (B, h, w, A) → (B, h, w, A·K)
+    bf16 ``e`` with e = label / k (negative) / k+1 (ignore).  Requires
+    k <= 255 (see _nhwc_masks)."""
+    lead = labels4.shape[:-1]
+    a_loc = labels4.shape[-1]
+    ck = a_loc * k
     neg, ign = float(k), float(k + 1)  # sentinels outside the label range
     rep = np.zeros((a_loc, ck), np.float32)
     for a in range(a_loc):
         rep[a, a * k : (a + 1) * k] = 1.0
     rep = jnp.asarray(rep, dtype=jnp.bfloat16)
-    k_idx = jnp.asarray(np.arange(ck) % k, dtype=jnp.bfloat16)
     e = jnp.where(
         state4 == matching.POSITIVE,
         labels4.astype(jnp.float32),
         jnp.where(state4 == matching.IGNORE, ign, neg),
     )
-    e_ck = (e.astype(jnp.bfloat16).reshape(-1, a_loc) @ rep).reshape(*lead, ck)
-    return e_ck == k_idx, e_ck != ign
+    return (e.astype(jnp.bfloat16).reshape(-1, a_loc) @ rep).reshape(*lead, ck)
+
+
+def _masks_from_encode(
+    e_ck: jnp.ndarray, k: int
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    k_idx = jnp.asarray(np.arange(e_ck.shape[-1]) % k, dtype=jnp.bfloat16)
+    return e_ck == k_idx, e_ck != float(k + 1)
 
 
 @partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -392,15 +409,30 @@ def _focal_nhwc_level_sums(
 
 
 def _focal_nhwc_level_sums_fwd(cls_l, labels4, state4, alpha, gamma):
-    return (
-        _focal_nhwc_level_sums(cls_l, labels4, state4, alpha, gamma),
-        (cls_l, labels4, state4),
-    )
+    k = cls_l.shape[-1] // labels4.shape[-1]
+    if k > 255:
+        out = _focal_nhwc_level_sums(cls_l, labels4, state4, alpha, gamma)
+        return out, (cls_l, labels4, state4, None)
+    # Save the bf16 encoded-target tensor as the residual: backward reads
+    # it instead of re-running the mask matmul (one 258 MB read vs
+    # dot + write + read at the flagship bucket).
+    e_ck = _nhwc_encode(labels4, state4, k)
+    t_ck, ni_ck = _masks_from_encode(e_ck, k)
+    fl = _focal_nhwc_elementwise(cls_l.astype(jnp.float32), t_ck, alpha, gamma)
+    out = jnp.sum(jnp.where(ni_ck, fl, 0.0), axis=(-3, -2, -1))
+    # state4 is NOT a residual on this path (backward only needs its shape,
+    # == labels4's, for the float0 cotangent) — holding it would keep dead
+    # bytes alive across the whole backbone backward.
+    return out, (cls_l, labels4, None, e_ck)
 
 
 def _focal_nhwc_level_sums_bwd(alpha, gamma, res, g):
-    cls_l, labels4, state4 = res
-    t_ck, ni_ck = _nhwc_masks(labels4, state4, cls_l.shape[-1] // labels4.shape[-1])
+    cls_l, labels4, state4, e_ck = res
+    k = cls_l.shape[-1] // labels4.shape[-1]
+    if e_ck is None:
+        t_ck, ni_ck = _nhwc_masks(labels4, state4, k)
+    else:
+        t_ck, ni_ck = _masks_from_encode(e_ck, k)
     x = cls_l.astype(jnp.float32)
     # d f / d x in closed form, one fused elementwise pass:
     #   s = sigmoid(x), spn = softplus(-x), spp = softplus(x)
@@ -415,7 +447,7 @@ def _focal_nhwc_level_sums_bwd(alpha, gamma, res, g):
     # g has the per-image shape (...,); broadcast over (h, w, ck).
     dcls = (g[..., None, None, None] * df).astype(cls_l.dtype)
     f0 = lambda a: np.zeros(a.shape, jax.dtypes.float0)  # int-array cotangents
-    return dcls, f0(labels4), f0(state4)
+    return dcls, f0(labels4), f0(labels4)  # state4 shares labels4's shape
 
 
 _focal_nhwc_level_sums.defvjp(_focal_nhwc_level_sums_fwd, _focal_nhwc_level_sums_bwd)

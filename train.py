@@ -411,6 +411,19 @@ def main(argv=None) -> dict[str, float]:
                 "--spatial-shards is exclusive with --shard-weight-update "
                 "and --quantized-allreduce"
             )
+        if (
+            jax.process_count() > 1
+            and len(jax.local_devices()) % spatial_shards
+        ):
+            # The space axis must stay within one host: the per-process
+            # batch assembly hands each process its own full-H images, so a
+            # space row straddling hosts would silently stitch H-slices of
+            # DIFFERENT hosts' images into one "global" image.
+            raise SystemExit(
+                f"--spatial-shards {spatial_shards} must divide the "
+                f"per-host device count {len(jax.local_devices())} on "
+                "multi-host runs (the space axis cannot span hosts)"
+            )
         from batchai_retinanet_horovod_coco_tpu.parallel.mesh import (
             make_mesh_2d,
         )

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 import time
+import warnings
 from typing import Any, Callable, Iterable, Iterator
 
 import jax
@@ -137,6 +138,40 @@ def _prefetch_to_device(
     except StopIteration:
         while buf:
             yield buf.popleft()
+
+
+def _compile_barrier(step_fn, state, device_arrays, hw) -> None:
+    """Compile the step, then barrier at the COORDINATION SERVICE before
+    its first execution on multi-process runs.
+
+    XLA:CPU's Gloo collectives carry a hardcoded ~30 s receive timeout,
+    and TPU collectives have finite timeouts too — while a cold step
+    compile takes minutes.  Without this, the first process to finish
+    compiling enters the step's collectives and times out waiting for
+    peers still compiling (observed as deterministic-looking
+    Gloo ReduceScatter failures in the 2-process ZeRO world, round 3).
+    The coordination-service barrier (gRPC, 10 min budget) holds everyone
+    until every process has COMPILED; execution then starts aligned.
+    Falls back to doing nothing when the AOT surface or the distributed
+    client is unavailable (single-process, or a step wrapper without
+    ``lower``).
+    """
+    if jax.process_count() <= 1:
+        return
+    lower = getattr(step_fn, "lower", None)
+    if lower is None:
+        return
+    try:
+        lower(state, device_arrays).compile()
+        from jax._src import distributed
+
+        client = distributed.global_state.client
+        if client is not None:
+            client.wait_at_barrier(
+                f"train_step_compiled_{hw[0]}x{hw[1]}", 600_000
+            )
+    except Exception as e:  # pragma: no cover - environment-specific
+        warnings.warn(f"compile barrier skipped: {e!r}")
 
 
 def run_training(
@@ -289,6 +324,9 @@ def run_training(
                     shard_weight_update=shard_weight_update,
                     quantized_allreduce=quantized_allreduce,
                 )
+            # No process may enter the step's collectives while a peer is
+            # still compiling (collective timeouts << compile times).
+            _compile_barrier(step_fn, state, device_arrays, hw)
         if config.profile_dir and step == prof_start:
             jax.profiler.start_trace(config.profile_dir)
         state, metrics = step_fn(state, device_arrays)

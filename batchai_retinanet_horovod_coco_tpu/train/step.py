@@ -282,7 +282,7 @@ def make_train_step(
         # optimizer) gets fresh partition specs instead of stale ones.
         cache: dict[Any, Callable] = {}
 
-        def zero_entry(state: TrainState, batch: dict[str, Any]):
+        def get_step(state: TrainState) -> Callable:
             key = (
                 jax.tree.structure(state.opt_state),
                 jax.tree.structure(state.params),
@@ -290,8 +290,16 @@ def make_train_step(
             )
             if key not in cache:
                 cache[key] = make_zero_step(state)
-            return cache[key](state, batch)
+            return cache[key]
 
+        def zero_entry(state: TrainState, batch: dict[str, Any]):
+            return get_step(state)(state, batch)
+
+        # AOT surface for the loop's multi-process compile barrier
+        # (train/loop.py::_compile_barrier): compile without executing.
+        zero_entry.lower = lambda state, batch: get_step(state).lower(
+            state, batch
+        )
         return zero_entry
 
     @partial(

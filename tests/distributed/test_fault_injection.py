@@ -25,7 +25,14 @@ def _run(out_dir, die_before_step, expect_kill=False):
     env = {
         k: v
         for k, v in os.environ.items()
-        if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
+        # JAX_COMPILATION_CACHE_DIR must NOT leak into multi-process worlds:
+        # the session cache can hold XLA:CPU AOT entries compiled with
+        # different target-machine features; each mismatched entry costs a
+        # failed-load + recompile (~25-35 s observed), the two processes
+        # desynchronize, and the first cross-process collective dies on
+        # Gloo's read timeout (reproduced deterministically in round 3 on
+        # the ZeRO resume phase, which compiles the most programs).
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "JAX_COMPILATION_CACHE_DIR")
     }
     repo_root = os.path.dirname(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -33,6 +40,7 @@ def _run(out_dir, die_before_step, expect_kill=False):
     env["PYTHONPATH"] = os.pathsep.join(
         p for p in (repo_root, env.get("PYTHONPATH")) if p
     )
+    env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(str(out_dir), "jax_cache")
     proc = subprocess.run(
         [sys.executable, _WORKER, str(out_dir), str(TOTAL_STEPS),
          str(die_before_step)],

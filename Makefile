@@ -35,6 +35,14 @@ delete:
 test:
 	python -m pytest tests/ -q
 
+# Regenerate the committed per-test timing snapshot (budget mechanism,
+# tests/conftest.py): run the fast tier warm, write TEST_TIMINGS.md.
+# bash + pipefail: a failing tier must NOT regenerate/bless the snapshot.
+test-timings:
+	bash -o pipefail -c 'python -m pytest tests/ -q -m "not slow" \
+	  --durations=40 | tee /tmp/fast_tier_timings.log'
+	python scripts/update_test_timings.py /tmp/fast_tier_timings.log
+
 # End-to-end synthetic smoke on a virtual CPU mesh (no data, no TPU needed).
 smoke:
 	python train.py synthetic --platform cpu --backbone resnet_test --f32 \

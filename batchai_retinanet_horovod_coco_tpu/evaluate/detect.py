@@ -4,7 +4,7 @@ Replaces the reference's separate "inference model" conversion step and its
 ``Anchors → RegressBoxes → ClipBoxes → FilterDetections`` layer stack
 (SURVEY.md M3/M6, call stack 3.5, ``bin/convert_model.py``): here inference
 is just another jitted function over the same train-state params, with the
-whole post-processing (sigmoid, top-k pre-select, class-offset NMS) running
+whole post-processing (sigmoid, top-k pre-select, class-masked NMS) running
 on the TPU per BASELINE.json configs[4] ("on-device batched NMS").
 
 ``run_coco_eval`` is the dataset-level driver (the ``CocoEval`` callback /

@@ -590,11 +590,27 @@ class ResNet(nn.Module):
     # packed into channels; math-identical, same param tree (see the
     # width-packing block above).  Needs stage2 width (ceil(W_img/4)) even.
     pack_width: bool = False
+    # Stem downsample: "max" is the canonical 3x3/2 maxpool.  "avg" swaps in
+    # an avg pool of the same geometry — a DIAGNOSTIC configuration whose
+    # gradient is linear and therefore tie-free: maxpool backward routes
+    # each window's cotangent to its first max, and which element wins a
+    # tie is partition-dependent under GSPMD spatial sharding
+    # (tests/distributed/test_spatial_train.py uses this knob to prove the
+    # spatial step's gradient divergence lives ENTIRELY in the pool).
+    stem_pool: str = "max"  # "max" | "avg"
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = False) -> dict[str, jnp.ndarray]:
         if self.stem not in ("conv", "space_to_depth", "space_to_depth4"):
             raise ValueError(f"unknown stem: {self.stem!r}")
+        if self.stem_pool not in ("max", "avg"):
+            raise ValueError(f"unknown stem_pool: {self.stem_pool!r}")
+        if self.stem_pool == "avg" and self.stem != "conv":
+            raise ValueError(
+                "stem_pool='avg' (the tie-free diagnostic pool) is only "
+                "supported with stem='conv' — the packed stem layouts bake "
+                "in the maxpool (maxpool_packed_w)"
+            )
         norm = NormFactory(self.norm_kind, self.dtype)
         x = x.astype(self.dtype)
         # The h2w4 stem lowering keeps its output packed (B, H/2, W/4,
@@ -621,11 +637,18 @@ class ResNet(nn.Module):
         else:
             x = norm("stem_norm", train)(x)
             x = nn.relu(x)
-            # Symmetric (1, 1) padding (torch geometry; SAME would pad
-            # (0, 1) on even dims).  -inf pad so padding never wins the max.
-            x = nn.max_pool(
-                x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1))
-            )
+            if self.stem_pool == "avg":
+                # Tie-free diagnostic downsample (see stem_pool field doc).
+                x = nn.avg_pool(
+                    x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1))
+                )
+            else:
+                # Symmetric (1, 1) padding (torch geometry; SAME would pad
+                # (0, 1) on even dims).  -inf pad so padding never wins the
+                # max.
+                x = nn.max_pool(
+                    x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1))
+                )
 
         features: dict[str, jnp.ndarray] = {}
         filters = 64

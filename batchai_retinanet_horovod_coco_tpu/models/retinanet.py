@@ -47,6 +47,11 @@ class RetinaNetConfig:
     # tested reformulation for narrow-channel-bound shapes/hardware.
     # ResNet backbones only; needs W_img divisible by 8.
     pack_width: bool = False
+    # "avg" swaps the ResNet stem maxpool for a tie-free avg pool — a
+    # diagnostic config for gradient-parity tests under GSPMD spatial
+    # partitioning (models/resnet.py ResNet.stem_pool); requires
+    # stem="conv".  ResNet backbones only.
+    stem_pool: str = "max"
     fpn_channels: int = 256
     head_width: int = 256
     head_depth: int = 4
@@ -92,6 +97,13 @@ def build_backbone(cfg: "RetinaNetConfig"):
             f"pack_width is a ResNet-stage2 reformulation; backbone "
             f"{name!r} does not support it"
         )
+    if cfg.stem_pool != "max" and stages is None:
+        # Mirror the pack_width guard above: a diagnostic knob that only
+        # the ResNet stem implements must not be silently ignored.
+        raise ValueError(
+            f"stem_pool={cfg.stem_pool!r} is only supported by ResNet "
+            f"backbones, not {name!r}"
+        )
     if stages is not None:
         return ResNet(
             stage_sizes=stages,
@@ -99,6 +111,7 @@ def build_backbone(cfg: "RetinaNetConfig"):
             dtype=cfg.dtype,
             stem=cfg.stem,
             pack_width=cfg.pack_width,
+            stem_pool=cfg.stem_pool,
             name="backbone",
         )
     if name in ("mobilenet", "mobilenet050"):

@@ -50,7 +50,32 @@ def make_mesh_2d(num_data: int, num_space: int) -> Mesh:
     if n > len(devices):
         raise ValueError(f"requested {n} devices, have {len(devices)}")
     grid = np.asarray(devices[:n]).reshape(num_data, num_space)
+    _assert_space_rows_single_process(grid)
     return Mesh(grid, axis_names=(DATA_AXIS, SPACE_AXIS))
+
+
+def _assert_space_rows_single_process(grid) -> None:
+    """Each space row (one image's H shards) must live on ONE process.
+
+    Per-process batch assembly hands each process its own full-H images
+    (``make_array_from_process_local_data``), so a space row straddling
+    hosts would silently stitch H-slices of DIFFERENT hosts' images into
+    one "global" image.  Guarded here — not only in the train.py CLI — so
+    library callers fail the same way (ADVICE r3).  The check is on the
+    actual device placement (not a per-host-count divisibility proxy), so
+    valid sub-meshes — e.g. a space axis entirely on host 0's devices in
+    a multi-host world — are not spuriously refused.
+    """
+    for row in grid:
+        owners = {d.process_index for d in row}
+        if len(owners) > 1:
+            raise ValueError(
+                f"space axis row {[str(d) for d in row]} spans processes "
+                f"{sorted(owners)} — the space axis cannot span hosts: "
+                "each image's H shards must sit on one process's devices "
+                "(pick num_space dividing the per-host device count, or "
+                "reorder/restrict the device list)"
+            )
 
 
 def make_local_mesh() -> Mesh:

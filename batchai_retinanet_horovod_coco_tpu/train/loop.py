@@ -152,26 +152,44 @@ def _compile_barrier(step_fn, state, device_arrays, hw) -> None:
     Gloo ReduceScatter failures in the 2-process ZeRO world, round 3).
     The coordination-service barrier (gRPC, 10 min budget) holds everyone
     until every process has COMPILED; execution then starts aligned.
-    Falls back to doing nothing when the AOT surface or the distributed
-    client is unavailable (single-process, or a step wrapper without
-    ``lower``).
+
+    Error policy: a genuine compile failure PROPAGATES (the step would
+    fail at dispatch anyway, and a swallowed compile error would defeat
+    the barrier — the healthy peers would time out in collectives while
+    this process died later with a confusing secondary error).  Only the
+    genuinely optional pieces degrade to a skip: a step wrapper without
+    the AOT ``lower`` surface, the private ``jax._src.distributed``
+    module moving across JAX versions, or no distributed client (world
+    brought up outside ``jax.distributed.initialize``).
+
+    Bucket-order assumption: the barrier name is derived from the (H, W)
+    bucket, so every process must reach new buckets in the same order.
+    That holds by construction here — the global batch is assembled from
+    aligned per-process shards of one global stream, so every process
+    sees the same bucket at the same step index.  A custom per-process
+    pipeline that broke this would park processes at differently-named
+    barriers until the 10-minute budget expires (a loud, attributable
+    failure rather than a silent data skew).
     """
     if jax.process_count() <= 1:
         return
     lower = getattr(step_fn, "lower", None)
     if lower is None:
-        return
+        return  # no AOT surface: first dispatch compiles (and may skew)
+    lower(state, device_arrays).compile()  # compile errors propagate
     try:
-        lower(state, device_arrays).compile()
+        # Private module; narrow the except to exactly the "JAX moved it"
+        # failure so real errors (including barrier timeout) still raise.
         from jax._src import distributed
-
-        client = distributed.global_state.client
-        if client is not None:
-            client.wait_at_barrier(
-                f"train_step_compiled_{hw[0]}x{hw[1]}", 600_000
-            )
-    except Exception as e:  # pragma: no cover - environment-specific
+    except ImportError as e:  # pragma: no cover - version-specific
         warnings.warn(f"compile barrier skipped: {e!r}")
+        return
+    client = getattr(
+        getattr(distributed, "global_state", None), "client", None
+    )
+    if client is None:
+        return  # no coordination service (external world bring-up)
+    client.wait_at_barrier(f"train_step_compiled_{hw[0]}x{hw[1]}", 600_000)
 
 
 def run_training(

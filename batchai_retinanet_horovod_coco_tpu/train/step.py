@@ -335,6 +335,26 @@ def make_train_step(
     return jax.jit(sharded_step, donate_argnums=(0,) if donate_state else ())
 
 
+def _degenerate_strided_conv_heights(
+    image_h: int, num_space: int
+) -> list[int]:
+    """Stride-2 3x3 conv input heights inside the XLA weight-grad bug zone.
+
+    The model family's stride-2 3x3 convs consume maps at H/4, H/8, H/16
+    (ResNet stage3/4/5 ``conv2``), H/32 (FPN P6 reads C5) and H/64 (P7
+    reads P6).  Empirical risk zone (see make_train_step_spatial): shards
+    >= 8 AND rows-per-shard in [0.5, 2) — 2 rows/shard and the
+    replication-handled H < num_space/2 maps measured exact, as did every
+    layout at <= 4 shards (including exactly 1 row/shard, which IS broken
+    at 8 shards; the boundary is shard-count-dependent, so the canary test
+    pins both sides of it).
+    """
+    if num_space < 8:
+        return []
+    heights = [image_h // d for d in (4, 8, 16, 32, 64)]
+    return [h for h in heights if num_space / 2 <= h < 2 * num_space]
+
+
 def make_train_step_spatial(
     model,
     image_hw: tuple[int, int],
@@ -344,6 +364,8 @@ def make_train_step_spatial(
     matching_config: matching_lib.MatchingConfig = matching_lib.MatchingConfig(),
     anchor_config: anchors_lib.AnchorConfig | None = None,
     donate_state: bool = True,
+    allow_degenerate_spatial_sharding: bool = False,
+    allow_unvalidated_bf16: bool = False,
 ) -> Callable[[TrainState, dict[str, Any]], tuple[TrainState, dict[str, jnp.ndarray]]]:
     """Train step with the IMAGE sharded across chips (spatial partitioning).
 
@@ -359,9 +381,49 @@ def make_train_step_spatial(
     The step body is the plain single-device global-batch math (no
     explicit pmean): under GSPMD the compiler partitions the forward,
     inserts the halos, and turns the global loss/gradient reductions into
-    the right collectives.  Gradients therefore match the DP
-    ``shard_map`` step up to f32 reduction order (pinned by a test on the
-    virtual CPU mesh).
+    the right collectives.  Within the supported sharding envelope (below)
+    gradients match the single-device step to 1e-5-class agreement
+    (pinned by tests/distributed/test_spatial_train.py).
+
+    Sharding envelope: XLA's SPMD partitioner mis-computes the WEIGHT
+    gradient of a stride-2 3x3 conv whose per-shard input extent collapses
+    to ~one row (isolated repro in
+    tests/distributed/test_spatial_train.py::test_xla_strided_conv_grad_canary:
+    ~45% relative error on that conv's weight grad, persisting in f64 —
+    a genuinely different sum, not rounding — with both the GSPMD and
+    Shardy partitioners, jax 0.9.0; forward and grad-input are exact).
+    The boundary is EMPIRICAL and shard-count-dependent (round-4 probes,
+    pinned by the canary test): at 8 shards, 1 row/shard is badly wrong
+    (44%) and half-a-row/shard measurably wrong (1e-4-class on params),
+    while 2 rows/shard and the tiny H < num_space/2 maps (which the
+    partitioner handles via replication) are exact to 1e-15; at <= 4
+    shards every layout measured exact, INCLUDING 1 row/shard.  The
+    model family's stride-2 3x3 convs consume maps of H/4, H/8, H/16
+    (backbone stage3/4/5), H/32 (FPN P6 from C5) and H/64 (P7 from P6),
+    so this factory REFUSES meshes with ``space >= 8`` where any of those
+    heights lands in the measured risk zone
+    [num_space/2, 2*num_space).  ``allow_degenerate_spatial_sharding=True``
+    overrides (the parity tests use it to pin the divergence magnitude);
+    expect 1e-3-class relative gradient error in the affected conv
+    kernels until the upstream fix (at which point the canary test fails
+    and this guard should be dropped).
+
+    Dtype envelope: bf16 models at flagship width are MISCOMPILED by the
+    SPMD partitioner under this step's shardings (round-4 finding, pinned
+    by test_spatial_train.py::test_xla_bf16_spatial_step_canary): with the
+    box gradient in the graph, the forward cls_loss VALUE comes out wrong
+    — 1.128 → 1.420 (gn) / 2.82 (frozen_bn) with gradients 14–60x off —
+    deterministically, at 256-wide heads, while f32 at the same width and
+    bf16 at width 64 are exact; the wrong value changes when unrelated
+    graph consumers (e.g. ``optax.global_norm(grads)``) are added, the
+    signature of a partitioner miscompilation, and persists across the
+    mask/custom-VJP/planar-layout variants of the loss.  Reproduced on the
+    virtual CPU mesh (jax 0.9.0); real multi-chip TPU is unavailable to
+    this rig, so TPU is UNVALIDATED rather than known-good.  The factory
+    therefore refuses non-f32 models; ``allow_unvalidated_bf16=True``
+    overrides for users who have validated their own backend (run one
+    step of this factory's output against ``make_train_step(mesh=None)``
+    on an identical batch first — the canary shows exactly how).
 
     Pallas kernels are opaque to GSPMD and cannot be spatially
     partitioned: the fused assignment is forced off (the vmapped XLA
@@ -370,6 +432,37 @@ def make_train_step_spatial(
     """
     import dataclasses as _dc
 
+    from batchai_retinanet_horovod_coco_tpu.parallel.mesh import SPACE_AXIS
+
+    model_dtype = jnp.dtype(model.config.dtype)
+    if model_dtype != jnp.dtype(jnp.float32) and not allow_unvalidated_bf16:
+        raise ValueError(
+            f"spatial partitioning with a {model_dtype.name} model is "
+            "refused: the SPMD partitioner miscompiles the bf16 train "
+            "step at flagship width (wrong cls_loss values, 14-60x wrong "
+            "gradients — see make_train_step_spatial's docstring and the "
+            "bf16 spatial canary test).  Train spatially in f32 "
+            "(--f32 with --spatial-shards), or pass "
+            "allow_unvalidated_bf16=True after validating one step "
+            "against the single-device step on your backend"
+        )
+
+    num_space = dict(mesh.shape).get(SPACE_AXIS, 1)
+    if not allow_degenerate_spatial_sharding:
+        risky = _degenerate_strided_conv_heights(image_hw[0], num_space)
+        if risky:
+            raise ValueError(
+                f"space axis size {num_space} is too large for image "
+                f"height {image_hw[0]}: stride-2 3x3 conv input maps of "
+                f"height {risky} would land in the measured envelope "
+                "where XLA's SPMD partitioner mis-computes strided-conv "
+                "weight gradients (~[0.5, 2) rows per shard at >= 8 "
+                "shards; see make_train_step_spatial docstring).  Use a "
+                "smaller --spatial-shards for this bucket (space <= 4 is "
+                "always outside the envelope), or pass "
+                "allow_degenerate_spatial_sharding=True to accept "
+                "1e-3-class gradient error in the affected conv kernels"
+            )
     if loss_config.pallas_focal:
         raise ValueError(
             "pallas_focal is incompatible with spatial partitioning: a "

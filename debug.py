@@ -10,6 +10,16 @@ annotated JPEGs (gt boxes green, positive anchors blue) to ``--output-dir``.
 Usage:
   python debug.py coco /data/coco [--limit 8] [--output-dir /tmp/vis]
   python debug.py synthetic [--limit 8]
+  python debug.py buckets /data/coco/annotations/instances_train2017.json
+
+``buckets`` derives the EXACT static-bucket shares for a dataset from the
+annotation file alone (COCO records carry width/height; nothing is
+decoded): for every image it applies the reference resize rule + bucket
+pick the pipeline uses (data/pipeline.resize_scale/pick_bucket) and prints
+per-bucket image counts/shares — the measured replacement for the
+estimated COCO aspect shares baked into bench.py's weighted mix
+(BUCKETBENCH.json).  With --bucketbench it also recomputes the
+mix-weighted imgs/s/chip from the recorded per-bucket rates.
 """
 
 from __future__ import annotations
@@ -32,6 +42,15 @@ def build_parser() -> argparse.ArgumentParser:
     synth.add_argument("--synthetic-root", default="/tmp/synthetic_coco_debug")
     synth.add_argument("--synthetic-images", type=int, default=8)
     synth.add_argument("--synthetic-size", type=int, default=256)
+    bk = sub.add_parser("buckets")
+    bk.add_argument("annotation_file")
+    bk.add_argument("--image-min-side", type=int, default=800)
+    bk.add_argument("--image-max-side", type=int, default=1333)
+    bk.add_argument(
+        "--bucketbench", default=None,
+        help="path to a BUCKETBENCH.json; recompute its weighted_mix "
+        "with the measured shares",
+    )
     for sp in (coco, synth):
         sp.add_argument("--limit", type=int, default=8)
         sp.add_argument("--image-min-side", type=int, default=800)
@@ -47,10 +66,101 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def bucket_shares(
+    annotation_file: str, min_side: int, max_side: int
+) -> dict[str, dict]:
+    """Per-bucket image counts/shares for a COCO-format annotation file.
+
+    Pure metadata pass (width/height from the records; no image decode):
+    for each image, apply the pipeline's own resize rule and bucket pick
+    (data/pipeline.resize_scale/pick_bucket over
+    default_buckets(min_side, max_side)) and tally.
+    """
+    from batchai_retinanet_horovod_coco_tpu.data import CocoDataset
+    from batchai_retinanet_horovod_coco_tpu.data.pipeline import (
+        default_buckets,
+        pick_bucket,
+        resize_scale,
+    )
+
+    dataset = CocoDataset(annotation_file, image_dir=".")
+    buckets = default_buckets(min_side, max_side)
+    counts: dict[tuple[int, int], int] = {b: 0 for b in buckets}
+    for rec in dataset.records:
+        scale = resize_scale(rec.height, rec.width, min_side, max_side)
+        h = int(round(rec.height * scale))
+        w = int(round(rec.width * scale))
+        counts[pick_bucket(h, w, buckets)] += 1
+    total = max(sum(counts.values()), 1)
+    return {
+        f"{b[0]}x{b[1]}": {"count": n, "share": n / total}
+        for b, n in counts.items()
+    }
+
+
+def _run_buckets(args) -> dict:
+    import json
+
+    shares = bucket_shares(
+        args.annotation_file, args.image_min_side, args.image_max_side
+    )
+    for name, row in shares.items():
+        print(f"{name}: {row['count']} images ({row['share']:.1%})")
+    out = {"shares": shares}
+    if args.bucketbench:
+        with open(args.bucketbench) as f:
+            bench = json.load(f)
+        # Accept both schemas: the committed BUCKETBENCH.json (long keys)
+        # and a saved `python bench.py` JSON line (short keys).
+        rates = bench.get("per_bucket_imgs_per_sec_per_chip") or bench.get(
+            "per_bucket"
+        )
+        if rates is None:
+            raise SystemExit(
+                f"{args.bucketbench}: no per-bucket rates found (expected "
+                "'per_bucket_imgs_per_sec_per_chip' or bench.py's "
+                "'per_bucket')"
+            )
+        recorded = bench.get(
+            "weighted_mix_imgs_per_sec_per_chip", bench.get("weighted_mix")
+        )
+        missing = [
+            name
+            for name, row in shares.items()
+            if row["share"] > 0 and name not in rates
+        ]
+        if missing:
+            raise SystemExit(
+                f"{args.bucketbench} has no rate for bucket(s) {missing} "
+                f"(it records {sorted(rates)}): the bench was recorded at "
+                "a different --image-min-side/--image-max-side bucket "
+                "config — re-run bench.py at this config first"
+            )
+        # Harmonic mix: average seconds/image under the measured shares.
+        cost = sum(
+            row["share"] / rates[name]
+            for name, row in shares.items()
+            if row["share"] > 0
+        )
+        mix = 1.0 / cost if cost else None
+        out["weighted_mix_imgs_per_sec_per_chip"] = mix
+        if mix is None:
+            print("no images landed in any bucket; weighted mix undefined")
+        else:
+            print(
+                f"mix-weighted rate at these shares: {mix:.2f} imgs/s/chip "
+                f"(recorded estimate: {recorded})"
+            )
+    return out
+
+
 def main(argv=None) -> list[dict]:
     args = build_parser().parse_args(argv)
     # Host debugging tool: tiny per-image ops, not worth a TPU round trip.
     jax.config.update("jax_platforms", "cpu")
+
+    if args.dataset_type == "buckets":
+        return [_run_buckets(args)]
 
     from batchai_retinanet_horovod_coco_tpu.data import (
         CocoDataset,

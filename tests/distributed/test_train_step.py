@@ -90,8 +90,12 @@ def test_dp_grads_equal_single_device(model_and_state):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
 
+@pytest.mark.slow
 def test_loss_decreases_overfit():
-    """Fixed batch, 12 sharded steps: loss must go down (integration smoke)."""
+    """Fixed batch, 12 sharded steps: loss must go down (integration smoke).
+
+    Slow tier: 35 s (round-4 timing report), and the CLI test suite's
+    end-to-end synthetic train covers the same learn-something contract."""
     model = build_retinanet(tiny_config())
     state = create_train_state(
         model, optax.adam(1e-3), (1, *HW, 3), jax.random.key(0)

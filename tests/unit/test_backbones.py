@@ -27,17 +27,22 @@ HW = (64, 64)
         (lambda: MobileNetV1(alpha=0.5, dtype=jnp.float32), (128, 256, 512)),
         (lambda: vgg16(dtype=jnp.float32), (256, 512, 512)),
         (lambda: vgg19(dtype=jnp.float32), (256, 512, 512)),
-        (
+        # DenseNets build ~hundreds of concat/conv layers: 55 s / 28 s of
+        # CPU compile each (round-4 timing report) for a shape contract
+        # the other families already exercise — slow tier.
+        pytest.param(
             lambda: DenseNet(
                 stage_sizes=DENSENET_STAGES["densenet121"], dtype=jnp.float32
             ),
             (512, 1024, 1024),
+            marks=pytest.mark.slow,
         ),
-        (
+        pytest.param(
             lambda: DenseNet(
                 stage_sizes=DENSENET_STAGES["densenet169"], dtype=jnp.float32
             ),
             (512, 1280, 1664),
+            marks=pytest.mark.slow,
         ),
     ],
     ids=["mobilenet", "mobilenet-0.5", "vgg16", "vgg19", "densenet121",
@@ -57,7 +62,16 @@ def test_feature_strides_and_channels(factory, c_channels):
         )
 
 
-@pytest.mark.parametrize("backbone", ["mobilenet", "vgg16", "densenet121"])
+@pytest.mark.parametrize(
+    "backbone",
+    [
+        "mobilenet",
+        "vgg16",
+        # 40 s of densenet compile for assembly+grad already proven by the
+        # two lighter families — slow tier (round-4 timing report).
+        pytest.param("densenet121", marks=pytest.mark.slow),
+    ],
+)
 def test_retinanet_assembly_and_grad(backbone):
     """Backbone plugs into the full model and gradients flow."""
     model = build_retinanet(

@@ -73,3 +73,67 @@ class TestEvaluateCli:
             "voc_AP_2: 0.1000",
             "voc_AP_10: 0.2000",
         ]
+
+
+class TestBucketsCli:
+    """debug.py buckets: exact bucket shares from annotation metadata only."""
+
+    def _write_annotations(self, path, dims):
+        import json
+
+        blob = {
+            "categories": [{"id": 1, "name": "thing"}],
+            "images": [
+                {"id": i, "file_name": f"{i}.jpg", "width": w, "height": h}
+                for i, (w, h) in enumerate(dims)
+            ],
+            "annotations": [
+                {
+                    "id": i,
+                    "image_id": i,
+                    "category_id": 1,
+                    "bbox": [1, 1, 10, 10],
+                    "area": 100,
+                    "iscrowd": 0,
+                }
+                for i in range(len(dims))
+            ],
+        }
+        with open(path, "w") as f:
+            json.dump(blob, f)
+
+    def test_shares_and_weighted_mix(self, tmp_path, capsys):
+        import json
+
+        import debug
+
+        # 2 landscape (640x480 -> 800x1067 -> 800x1344 bucket), 1 portrait
+        # (480x640 -> 1067x800 -> 1344x800), 1 near-square landscape
+        # (500x500 -> 800x800 -> fits 800x1344, the smallest-area bucket).
+        ann = tmp_path / "instances.json"
+        self._write_annotations(
+            ann, [(640, 480), (640, 480), (480, 640), (500, 500)]
+        )
+        bench = tmp_path / "bucketbench.json"
+        with open(bench, "w") as f:
+            json.dump(
+                {
+                    "per_bucket_imgs_per_sec_per_chip": {
+                        "800x1344": 60.0,
+                        "1344x800": 60.0,
+                        "1088x1088": 30.0,
+                    }
+                },
+                f,
+            )
+        (out,) = debug.main(
+            ["buckets", str(ann), "--bucketbench", str(bench)]
+        )
+        shares = out["shares"]
+        assert shares["800x1344"]["count"] == 3
+        assert shares["1344x800"]["count"] == 1
+        assert shares["1088x1088"]["count"] == 0
+        assert abs(shares["800x1344"]["share"] - 0.75) < 1e-9
+        # All contributing buckets run at 60 -> harmonic mix is exactly 60
+        # (the zero-share 30.0 bucket must not drag it).
+        assert abs(out["weighted_mix_imgs_per_sec_per_chip"] - 60.0) < 1e-9

@@ -313,3 +313,66 @@ def test_nhwc_size_mismatch_raises():
             np.zeros((1, 12), np.int32),
             2,
         )
+
+
+def test_nhwc_wide_class_fallback_matches_concat():
+    """k > 255 exercises the bf16-unsafe fallback branch: broadcast-reshape
+    masks in _nhwc_masks plus the state4-carrying custom-VJP residual
+    (_focal_nhwc_level_sums_fwd returns e_ck=None, so backward re-derives
+    the masks from labels4/state4 instead of the saved encoding).  No other
+    test reaches this branch (ADVICE r3) — forward AND gradient must match
+    the concatenated reference path at small shapes with k = 260."""
+    from batchai_retinanet_horovod_coco_tpu.losses import (
+        total_loss_compact,
+        total_loss_compact_nhwc,
+    )
+
+    rng = np.random.default_rng(17)
+    B, K, A_LOC = 1, 260, 2
+    level_hw = ((2, 3), (1, 2))
+    level_sizes = [h * w * A_LOC for h, w in level_hw]
+    A = sum(level_sizes)
+    logits = rng.normal(0, 2, (B, A, K)).astype(np.float32)
+    box_preds = rng.normal(0, 1, (B, A, 4)).astype(np.float32)
+    box_t = rng.normal(0, 1, (B, A, 4)).astype(np.float32)
+    # Labels beyond 255 must appear so an encoding regression cannot hide.
+    labels = rng.integers(0, K, (B, A)).astype(np.int32)
+    labels[0, :3] = [256, 258, 259]
+    state = rng.choice([-1, 0, 1], (B, A), p=[0.2, 0.5, 0.3]).astype(np.int32)
+    state[0, :3] = 1
+
+    cls_levels, box_levels, off = [], [], 0
+    for (h, w), n in zip(level_hw, level_sizes):
+        cls_levels.append(logits[:, off : off + n].reshape(B, h, w, A_LOC * K))
+        box_levels.append(box_preds[:, off : off + n].reshape(B, h, w, A_LOC * 4))
+        off += n
+
+    want = total_loss_compact(logits, box_preds, labels, box_t, state)
+    got = total_loss_compact_nhwc(
+        tuple(cls_levels), tuple(box_levels), labels, box_t, state, A_LOC
+    )
+    for k in want:
+        np.testing.assert_allclose(float(got[k]), float(want[k]), rtol=1e-5)
+
+    def loss_nhwc(cls_ls):
+        return total_loss_compact_nhwc(
+            cls_ls, tuple(map(jnp.asarray, box_levels)), labels, box_t,
+            state, A_LOC,
+        )["loss"]
+
+    def loss_concat(lg):
+        return total_loss_compact(
+            lg, jnp.asarray(box_preds), labels, box_t, state
+        )["loss"]
+
+    g_nhwc = jax.grad(loss_nhwc)(tuple(map(jnp.asarray, cls_levels)))
+    g_concat = jax.grad(loss_concat)(jnp.asarray(logits))
+    off = 0
+    for i, n in enumerate(level_sizes):
+        np.testing.assert_allclose(
+            np.asarray(g_nhwc[i]).reshape(B, n, K),
+            np.asarray(g_concat[:, off : off + n]),
+            rtol=1e-5,
+            atol=1e-8,
+        )
+        off += n

@@ -93,9 +93,14 @@ def test_odd_stage2_width_rejected():
         model.init(jax.random.key(0), x)
 
 
+@pytest.mark.slow
 def test_grads_match_plain():
     """Autodiff through the kernel repack must produce the PLAIN gradients
-    (the structurally-zero blocks' cotangents drop in the gather transpose)."""
+    (the structurally-zero blocks' cotangents drop in the gather transpose).
+
+    Slow tier: ~40 s of compile (round-4 timing report) for a retired-by-
+    default lever (pack_width is a measured-negative config on v5e); the
+    forward equivalence tests keep its correctness pinned in fast."""
     x = _input(1)
     plain, packed = _build(False, "gn"), _build(True, "gn")
     variables = plain.init(jax.random.key(0), x)

@@ -411,6 +411,45 @@ def main(argv=None) -> dict[str, float]:
                 "--spatial-shards is exclusive with --shard-weight-update "
                 "and --quantized-allreduce"
             )
+        if not args.f32:
+            # The SPMD partitioner miscompiles the bf16 spatial train step
+            # at flagship width (wrong cls_loss, 14-60x wrong grads;
+            # train/step.py::make_train_step_spatial docstring + the bf16
+            # spatial canary test).  Refuse loudly rather than train on
+            # silently corrupted gradients.
+            raise SystemExit(
+                "--spatial-shards requires --f32: bf16 spatial train "
+                "steps are miscompiled by XLA's SPMD partitioner "
+                "(validated on the CPU mesh rig; TPU unvalidated — see "
+                "make_train_step_spatial's docstring)"
+            )
+        # Fail fast on the strided-conv sharding envelope for EVERY bucket
+        # this run will compile, instead of letting make_train_step_spatial
+        # raise mid-training when the offending bucket first arrives.
+        from batchai_retinanet_horovod_coco_tpu.data.pipeline import (
+            default_buckets,
+        )
+        from batchai_retinanet_horovod_coco_tpu.train.step import (
+            _degenerate_strided_conv_heights,
+        )
+
+        bad = {
+            f"{h}x{w}": _degenerate_strided_conv_heights(h, spatial_shards)
+            for h, w in default_buckets(
+                args.image_min_side, args.image_max_side
+            )
+            if _degenerate_strided_conv_heights(h, spatial_shards)
+        }
+        if bad:
+            raise SystemExit(
+                f"--spatial-shards {spatial_shards} puts bucket(s) "
+                f"{sorted(bad)} inside the XLA strided-conv weight-grad "
+                "bug envelope (conv input heights "
+                f"{sorted(set(sum(bad.values(), [])))} at ~[0.5, 2) rows "
+                "per shard; see make_train_step_spatial).  Use "
+                "--spatial-shards 4 or fewer, which is always outside "
+                "the envelope"
+            )
         if (
             jax.process_count() > 1
             and len(jax.local_devices()) % spatial_shards

@@ -172,6 +172,21 @@ def resize_scale(h: int, w: int, min_side: int, max_side: int) -> float:
     return scale
 
 
+def bucket_for_source(
+    h: int,
+    w: int,
+    min_side: int,
+    max_side: int,
+    buckets: tuple[tuple[int, int], ...],
+) -> tuple[int, int]:
+    """Bucket a SOURCE-resolution image lands in: the pipeline's own
+    resize rule + rounding + bucket pick, in one place — shared by the
+    pipeline's batch former and by ``debug.py buckets`` so the measured
+    bucket shares cannot drift from what the producer actually does."""
+    scale = resize_scale(h, w, min_side, max_side)
+    return pick_bucket(int(round(h * scale)), int(round(w * scale)), buckets)
+
+
 def pick_bucket(
     h: int, w: int, buckets: tuple[tuple[int, int], ...]
 ) -> tuple[int, int]:
@@ -339,12 +354,10 @@ def build_pipeline(
         return list(idx[config.shard_index :: config.shard_count])
 
     def record_bucket(record: ImageRecord) -> tuple[int, int]:
-        scale = resize_scale(
-            record.height, record.width, config.min_side, config.max_side
+        return bucket_for_source(
+            record.height, record.width, config.min_side, config.max_side,
+            config.buckets,
         )
-        nh = int(round(record.height * scale))
-        nw = int(round(record.width * scale))
-        return pick_bucket(nh, nw, config.buckets)
 
     out: queue.Queue = queue.Queue(maxsize=max(1, config.prefetch))
     stop = threading.Event()

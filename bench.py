@@ -41,19 +41,37 @@ WARMUP_STEPS = 5
 # window cuts that to ~±0.3 while keeping the whole bench under a minute.
 MEASURE_STEPS = 60
 
-# The other buckets the flagship-config pipeline emits
-# (data/pipeline.default_buckets(800, 1333)), with the approximate share
-# of COCO train2017 images that land in each under pick_bucket: landscape
-# AND near-square images fit 800x1344 (smallest fitting area), true
-# portraits go to 1344x800, and only mild portraits (aspect in
-# (1, ~1.36]) land in 1088x1088.  Shares are ESTIMATES from the public
-# COCO size distribution (~640x480-class landscape dominates; portraits
-# ~25%); re-derive exactly with debug.py buckets on the real annotations.
-SWEEP_BUCKETS: tuple[tuple[tuple[int, int], float], ...] = (
-    ((800, 1344), 0.74),
-    ((1344, 800), 0.22),
-    ((1088, 1088), 0.04),
-)
+# Approximate share of COCO train2017 images landing in each bucket the
+# flagship-config pipeline emits, in data/pipeline.default_buckets order
+# (landscape, portrait, mid-square): landscape AND near-square images fit
+# 800x1344 (smallest fitting area), true portraits go to 1344x800, and
+# only mild portraits (aspect in (1, ~1.36]) land in 1088x1088.  Shares
+# are ESTIMATES from the public COCO size distribution (~640x480-class
+# landscape dominates; portraits ~25%); re-derive exactly with
+# `debug.py buckets` on the real annotations.
+_MIX_SHARES = (0.74, 0.22, 0.04)
+
+
+def sweep_buckets() -> tuple[tuple[tuple[int, int], float], ...]:
+    """(bucket, share) pairs — shapes from the pipeline's single source
+    of truth (default_buckets), so the sweep cannot silently drift from
+    the shapes a training run actually compiles; only the COCO share
+    estimates live here."""
+    from batchai_retinanet_horovod_coco_tpu.data.pipeline import (
+        default_buckets,
+    )
+
+    buckets = default_buckets(800, 1333)
+    assert buckets[0] == BUCKET, (
+        f"default_buckets(800, 1333) now leads with {buckets[0]}, not "
+        f"{BUCKET} — update BUCKET (the round-over-round headline shape) "
+        "and _MIX_SHARES together"
+    )
+    if len(buckets) == 1:
+        return ((buckets[0], 1.0),)
+    return tuple(zip(buckets, _MIX_SHARES, strict=True))
+
+
 # Fewer timed steps for the non-flagship buckets: they only feed the
 # weighted mix, and the sweep must stay under the driver's bench budget.
 SWEEP_MEASURE_STEPS = 30
@@ -215,6 +233,7 @@ def main() -> None:
     }
 
     if sweep:
+        buckets = sweep_buckets()
         per_bucket = {f"{BUCKET[0]}x{BUCKET[1]}": value}
         rates = {BUCKET: ips}
         # Effective per-bucket batch: an OOM retry drops a bucket to batch
@@ -222,7 +241,7 @@ def main() -> None:
         # BUCKETBENCH.json batch_scaling) — record it so a mixed-batch
         # weighted_mix is visible instead of silently understated.
         bucket_batch = {f"{BUCKET[0]}x{BUCKET[1]}": flag_batch}
-        for hw, _share in SWEEP_BUCKETS:
+        for hw, _share in buckets:
             if hw == BUCKET:
                 continue
             b_eff, (b_ips, _b_mfu) = _run_with_oom_retry(
@@ -234,12 +253,12 @@ def main() -> None:
         # Mix-weighted throughput: steps are drawn per bucket with the
         # COCO aspect shares, so the average COST per image is the
         # share-weighted mean of 1/rate (harmonic mix), not of the rates.
-        total_share = sum(s for _, s in SWEEP_BUCKETS)
-        cost = sum(s / rates[hw] for hw, s in SWEEP_BUCKETS) / total_share
+        total_share = sum(s for _, s in buckets)
+        cost = sum(s / rates[hw] for hw, s in buckets) / total_share
         out["per_bucket"] = per_bucket
         out["weighted_mix"] = round(1.0 / cost, 3)
         out["mix_shares"] = {
-            f"{hw[0]}x{hw[1]}": s for hw, s in SWEEP_BUCKETS
+            f"{hw[0]}x{hw[1]}": s for hw, s in buckets
         }
         if len(set(bucket_batch.values())) > 1:
             out["per_bucket_batch"] = bucket_batch

@@ -78,19 +78,19 @@ def bucket_shares(
     """
     from batchai_retinanet_horovod_coco_tpu.data import CocoDataset
     from batchai_retinanet_horovod_coco_tpu.data.pipeline import (
+        bucket_for_source,
         default_buckets,
-        pick_bucket,
-        resize_scale,
     )
 
     dataset = CocoDataset(annotation_file, image_dir=".")
     buckets = default_buckets(min_side, max_side)
     counts: dict[tuple[int, int], int] = {b: 0 for b in buckets}
     for rec in dataset.records:
-        scale = resize_scale(rec.height, rec.width, min_side, max_side)
-        h = int(round(rec.height * scale))
-        w = int(round(rec.width * scale))
-        counts[pick_bucket(h, w, buckets)] += 1
+        counts[
+            bucket_for_source(
+                rec.height, rec.width, min_side, max_side, buckets
+            )
+        ] += 1
     total = max(sum(counts.values()), 1)
     return {
         f"{b[0]}x{b[1]}": {"count": n, "share": n / total}
@@ -147,9 +147,14 @@ def _run_buckets(args) -> dict:
         if mix is None:
             print("no images landed in any bucket; weighted mix undefined")
         else:
+            note = (
+                f" (recorded estimate: {recorded})"
+                if recorded is not None
+                else ""
+            )
             print(
-                f"mix-weighted rate at these shares: {mix:.2f} imgs/s/chip "
-                f"(recorded estimate: {recorded})"
+                f"mix-weighted rate at these shares: {mix:.2f} "
+                f"imgs/s/chip{note}"
             )
     return out
 

@@ -20,7 +20,11 @@ def main(log_path: str) -> None:
         log,
         re.M,
     )
-    total = re.search(r" in ([0-9.]+)s(?: \(([0-9:]+)\))?", log)
+    # Wall time from the matched summary line itself (an earlier log line
+    # like "retried in 0.5s" must not win).
+    total = (
+        re.search(r" in ([0-9.]+)s", tail.group(1)) if tail else None
+    )
     wall = f"{float(total.group(1)):.0f} s wall" if total else "wall unknown"
     lines = [
         "# Fast-tier test timings (`pytest -m \"not slow\"`, warm cache)",

@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Standalone repro: wrong bf16 forward loss under GSPMD spatial sharding.
+
+A bf16 RetinaNet at flagship head width (256) with its images H-sharded
+over a 2-D (data, space) mesh returns a WRONG forward cls_loss value —
+1.128 single-device vs 1.420 sharded (gn norm; 2.82 with frozen_bn) with
+gradients 14-60x off — deterministically, once the box-regression
+gradient is part of the program.  Signatures of a partitioner
+miscompilation rather than arithmetic noise (round-4 bisection,
+PARITY.md "A second partitioner miscompilation"):
+
+- f32 at the same width is exact; bf16 at head width 64 is exact.
+- The wrong value CHANGES when unrelated graph consumers are added
+  (loss-only jit: correct; + `optax.global_norm(grads)`: wrong).
+- Swapping the focal mask construction, the focal custom-VJP, and the
+  box-target memory layout all reproduce the same wrong bits.
+- Shardy produces bit-identical wrong values.
+- Constraining the head outputs to space-replicated before the loss
+  fixes the forward everywhere but frozen_bn gradients stay 3-13% off,
+  so part of the miscompilation is in the partitioned model backward.
+
+NOT yet minimized below "this model" — unlike the sibling strided-conv
+repro, the trigger needs the wide bf16 model with both loss terms.  Run
+on the 8-virtual-device CPU backend (jax 0.9.0):
+
+    python scripts/xla_repros/bf16_spatial_cls_loss.py
+
+This is the bug behind `make_train_step_spatial`'s f32-only gate
+(batchai_retinanet_horovod_coco_tpu/train/step.py) and is pinned by
+tests/distributed/test_spatial_train.py::test_xla_bf16_spatial_step_canary.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from batchai_retinanet_horovod_coco_tpu.models import (
+    RetinaNetConfig,
+    build_retinanet,
+)
+from batchai_retinanet_horovod_coco_tpu.parallel.mesh import make_mesh_2d
+from batchai_retinanet_horovod_coco_tpu.train import (
+    create_train_state,
+    make_train_step,
+)
+from batchai_retinanet_horovod_coco_tpu.train.step import (
+    make_train_step_spatial,
+)
+
+
+def main() -> None:
+    hw, k = (64, 64), 3
+    rng = np.random.default_rng(0)
+    batch = 8
+    gt_boxes = np.zeros((batch, 5, 4), np.float32)
+    gt_labels = np.zeros((batch, 5), np.int32)
+    gt_mask = np.zeros((batch, 5), bool)
+    for b in range(batch):
+        n = int(rng.integers(1, 4))
+        xy = rng.uniform(0, 32, (n, 2))
+        wh = rng.uniform(8, 30, (n, 2))
+        gt_boxes[b, :n] = np.concatenate([xy, xy + wh], 1)
+        gt_labels[b, :n] = rng.integers(0, k, n)
+        gt_mask[b, :n] = True
+    B = {
+        "images": jnp.asarray(
+            rng.integers(0, 255, (batch, *hw, 3)).astype(np.uint8)
+        ),
+        "gt_boxes": jnp.asarray(gt_boxes),
+        "gt_labels": jnp.asarray(gt_labels),
+        "gt_mask": jnp.asarray(gt_mask),
+    }
+    print(f"jax {jax.__version__}")
+    for dtype, name in ((jnp.bfloat16, "bf16"), (jnp.float32, "f32")):
+        model = build_retinanet(
+            RetinaNetConfig(
+                num_classes=k, backbone="resnet_test", norm_kind="gn",
+                dtype=dtype,
+            )
+        )
+        state = create_train_state(
+            model, optax.sgd(1e-2, momentum=0.9), (1, *hw, 3),
+            jax.random.key(0),
+        )
+        _, m1 = make_train_step(
+            model, hw, k, mesh=None, donate_state=False
+        )(state, B)
+        _, m2 = make_train_step_spatial(
+            model, hw, k, mesh=make_mesh_2d(4, 2), donate_state=False,
+            allow_unvalidated_bf16=True,
+        )(state, B)
+        cls1, cls2 = float(m1["cls_loss"]), float(m2["cls_loss"])
+        gn1, gn2 = float(m1["grad_norm"]), float(m2["grad_norm"])
+        wrong = abs(cls2 - cls1) / abs(cls1) > 0.01
+        print(
+            f"{name}: cls_loss {cls1:.5f} single vs {cls2:.5f} spatial; "
+            f"grad_norm {gn1:.3f} vs {gn2:.3f}  "
+            f"{'<== WRONG' if wrong else '(match)'}"
+        )
+
+
+if __name__ == "__main__":
+    main()

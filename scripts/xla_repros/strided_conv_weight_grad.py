@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Standalone repro: XLA SPMD mis-computes a strided-conv WEIGHT gradient.
+
+One `lax.conv_general_dilated` (3x3, stride 2, SAME-style (1,1) padding),
+input H-sharded over 8 devices with exactly ONE input row per shard:
+the weight gradient under the partitioner differs from the unsharded
+gradient by ~45% RELATIVE, in float64 (so it is a different sum, not
+rounding), with both the GSPMD and Shardy partitioners (jax 0.9.0,
+CPU backend with --xla_force_host_platform_device_count=8).
+
+Neighbouring configs are exact (<=1e-15 relative): kernel 1x1 or 5x5,
+stride 1, >=2 rows per shard, and 4 shards at one row per shard — the
+boundary is shard-count-dependent.  Forward values and the grad-input
+are exact in every probed config; only grad-weight is wrong.
+
+Run:  python scripts/xla_repros/strided_conv_weight_grad.py [shardy]
+
+This is the bug behind `make_train_step_spatial`'s sharding-envelope
+guard (batchai_retinanet_horovod_coco_tpu/train/step.py) and is pinned
+by tests/distributed/test_spatial_train.py::test_xla_strided_conv_grad_canary.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+if "shardy" in sys.argv[1:]:
+    jax.config.update("jax_use_shardy_partitioner", True)
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def rel_diff(shards: int, H: int, k: int = 3, stride: int = 2) -> float:
+    mesh = Mesh(
+        np.array(jax.devices()[:shards]).reshape(1, shards),
+        axis_names=("data", "space"),
+    )
+    rng = np.random.default_rng(0)
+    C = 16
+    x = rng.normal(0, 1, (2, H, H, C))
+    w = rng.normal(0, 0.1, (k, k, C, C))
+    Ho = (H + stride - 1) // stride
+    cot = rng.normal(0, 1, (2, Ho, Ho, C))
+    pad = ((k // 2, k // 2), (k // 2, k // 2))
+
+    def loss(w, x):
+        y = jax.lax.conv_general_dilated(
+            x, w, (stride, stride), pad,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        return jnp.sum(y * jnp.asarray(cot))
+
+    g_ref = jax.grad(loss)(jnp.asarray(w), jnp.asarray(x))
+    xsh = NamedSharding(mesh, P("data", "space"))
+    rep = NamedSharding(mesh, P())
+    g_sp = jax.jit(
+        jax.grad(loss), in_shardings=(rep, xsh), out_shardings=rep
+    )(jnp.asarray(w), jax.device_put(jnp.asarray(x), xsh))
+    d = float(np.max(np.abs(np.asarray(g_ref) - np.asarray(g_sp))))
+    return d / float(np.max(np.abs(np.asarray(g_ref))))
+
+
+if __name__ == "__main__":
+    print(f"jax {jax.__version__}; shardy={'shardy' in sys.argv[1:]}")
+    bad = rel_diff(shards=8, H=8)
+    print(f"8 shards, H=8 (1 row/shard), k=3 s=2: rel diff {bad:.3e}  "
+          f"{'<== WRONG' if bad > 1e-6 else '(fixed?)'}")
+    for shards, H, k, stride, label in [
+        (8, 16, 3, 2, "2 rows/shard"),
+        (8, 8, 1, 2, "k=1"),
+        (8, 8, 5, 2, "k=5"),
+        (8, 8, 3, 1, "stride 1"),
+        (4, 4, 3, 2, "4 shards, 1 row/shard"),
+    ]:
+        r = rel_diff(shards=shards, H=H, k=k, stride=stride)
+        print(f"{shards} shards, H={H} ({label}): rel diff {r:.3e}")

@@ -254,3 +254,50 @@ def test_compile_barrier_noop_single_process():
 
     assert jax.process_count() == 1
     loop_mod._compile_barrier(_RaisingLowerStep(), None, None, (64, 64))
+
+
+def test_mixed_bucket_stream_compiles_per_shape():
+    """The multiscale pipeline emits MULTIPLE (H, W) buckets in one run;
+    the loop must compile one step per bucket and keep training across
+    alternating shapes (SURVEY.md §7.3 hard part 1).  No prior test
+    streamed more than one bucket through run_training."""
+    model = tiny_model()
+    state = fresh_state(model)
+
+    shapes = [(64, 64), (64, 96)]
+
+    def stream():
+        rng = np.random.default_rng(0)
+        i = 0
+        while True:
+            h, w = shapes[i % len(shapes)]
+            i += 1
+            yield Batch(
+                images=rng.normal(0, 1, (2, h, w, 3)).astype(np.float32),
+                gt_boxes=np.tile(
+                    np.array([[8.0, 8.0, 40.0, 40.0]], np.float32), (2, 1, 1)
+                ),
+                gt_labels=np.ones((2, 1), np.int32),
+                gt_mask=np.ones((2, 1), bool),
+                image_ids=np.arange(2, dtype=np.int64),
+                scales=np.ones((2,), np.float32),
+                valid=np.ones((2,), bool),
+            )
+
+    class CapturingLogger:
+        def __init__(self):
+            self.records = []
+
+        def log(self, step, metrics, prefix="train"):
+            self.records.append((step, prefix, dict(metrics)))
+
+    logger = CapturingLogger()
+    out = run_training(
+        model, state, stream(), NUM_CLASSES,
+        LoopConfig(total_steps=4, log_every=1), logger=logger,
+    )
+    assert int(out.step) == 4
+    # Both buckets trained (each shape ran twice) and stayed finite.
+    train_recs = [r for r in logger.records if r[1] == "train"]
+    assert len(train_recs) == 4
+    assert all(np.isfinite(float(r[2]["loss"])) for r in train_recs)

@@ -42,21 +42,22 @@ WARMUP_STEPS = 5
 MEASURE_STEPS = 60
 
 # Approximate share of COCO train2017 images landing in each bucket the
-# flagship-config pipeline emits, in data/pipeline.default_buckets order
-# (landscape, portrait, mid-square): landscape AND near-square images fit
-# 800x1344 (smallest fitting area), true portraits go to 1344x800, and
-# only mild portraits (aspect in (1, ~1.36]) land in 1088x1088.  Shares
-# are ESTIMATES from the public COCO size distribution (~640x480-class
-# landscape dominates; portraits ~25%); re-derive exactly with
-# `debug.py buckets` on the real annotations.
-_MIX_SHARES = (0.74, 0.22, 0.04)
+# flagship-config pipeline emits, keyed by the bucket's ASPECT CLASS so
+# a reorder of default_buckets cannot silently swap shares: landscape
+# AND near-square images fit 800x1344 (smallest fitting area), true
+# portraits go to 1344x800, and only mild portraits (aspect in
+# (1, ~1.36]) land in the square 1088x1088.  Shares are ESTIMATES from
+# the public COCO size distribution (~640x480-class landscape dominates;
+# portraits ~25%); re-derive exactly with `debug.py buckets` on the real
+# annotations.
+_MIX_SHARES = {"landscape": 0.74, "portrait": 0.22, "square": 0.04}
 
 
 def sweep_buckets() -> tuple[tuple[tuple[int, int], float], ...]:
     """(bucket, share) pairs — shapes from the pipeline's single source
     of truth (default_buckets), so the sweep cannot silently drift from
     the shapes a training run actually compiles; only the COCO share
-    estimates live here."""
+    estimates live here, keyed by aspect class."""
     from batchai_retinanet_horovod_coco_tpu.data.pipeline import (
         default_buckets,
     )
@@ -69,7 +70,17 @@ def sweep_buckets() -> tuple[tuple[tuple[int, int], float], ...]:
     )
     if len(buckets) == 1:
         return ((buckets[0], 1.0),)
-    return tuple(zip(buckets, _MIX_SHARES, strict=True))
+
+    def aspect_class(hw: tuple[int, int]) -> str:
+        h, w = hw
+        return "landscape" if h < w else ("portrait" if h > w else "square")
+
+    classes = [aspect_class(b) for b in buckets]
+    assert sorted(classes) == sorted(_MIX_SHARES), (
+        f"default_buckets aspect classes {classes} no longer match the "
+        f"share table {sorted(_MIX_SHARES)} — update _MIX_SHARES"
+    )
+    return tuple((b, _MIX_SHARES[c]) for b, c in zip(buckets, classes))
 
 
 # Fewer timed steps for the non-flagship buckets: they only feed the

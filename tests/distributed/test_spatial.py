@@ -8,6 +8,7 @@ detections to the unsharded path on the same image.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from batchai_retinanet_horovod_coco_tpu.evaluate.detect import (
     DetectConfig,
@@ -53,3 +54,43 @@ def test_spatial_non_divisible_height(tiny_model_and_state):
     np.testing.assert_array_equal(a.labels, b.labels)
     np.testing.assert_allclose(a.scores, b.scores, rtol=1e-5, atol=1e-6)
     np.testing.assert_allclose(a.boxes, b.boxes, rtol=1e-4, atol=1e-3)
+
+
+@pytest.mark.slow
+def test_spatial_eval_bf16_flagship_width_matches():
+    """bf16 at flagship head width is exactly the regime where the spatial
+    TRAIN step is miscompiled (train/step.py f32 gate, round 4) — pin that
+    the forward-only EVAL program is clean there: detections from the
+    H-sharded program are IDENTICAL to the unsharded ones (measured
+    bitwise-equal on the CPU mesh; asserted with zero tolerance so any
+    future partitioner drift in the inference path is loud)."""
+    import optax
+
+    from batchai_retinanet_horovod_coco_tpu.models import (
+        RetinaNetConfig,
+        build_retinanet,
+    )
+    from batchai_retinanet_horovod_coco_tpu.train import create_train_state
+
+    model = build_retinanet(
+        RetinaNetConfig(
+            num_classes=3, backbone="resnet_test", norm_kind="gn",
+            dtype=jnp.bfloat16,
+        )
+    )
+    state = create_train_state(
+        model, optax.sgd(1e-2), (1, *HW, 3), jax.random.key(0)
+    )
+    config = DetectConfig(pre_nms_size=64, max_detections=10)
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.normal(0, 1, (2, *HW, 3)).astype(np.float32))
+    a = jax.device_get(make_detect_fn(model, HW, config)(state, images))
+    b = jax.device_get(
+        make_detect_fn_spatial(model, HW, config, mesh=make_mesh(8))(
+            state, images
+        )
+    )
+    np.testing.assert_array_equal(a.valid, b.valid)
+    np.testing.assert_array_equal(a.labels, b.labels)
+    np.testing.assert_array_equal(a.scores, b.scores)
+    np.testing.assert_array_equal(a.boxes, b.boxes)

@@ -426,9 +426,9 @@ def main(argv=None) -> dict[str, float]:
         # Fail fast on the strided-conv sharding envelope for EVERY bucket
         # this run will compile, instead of letting make_train_step_spatial
         # raise mid-training when the offending bucket first arrives.
-        from batchai_retinanet_horovod_coco_tpu.data.pipeline import (
-            default_buckets,
-        )
+        # (default_buckets is the module-level import — a function-local
+        # re-import here would shadow it for the whole function and break
+        # every non-spatial run with UnboundLocalError.)
         from batchai_retinanet_horovod_coco_tpu.train.step import (
             _degenerate_strided_conv_heights,
         )

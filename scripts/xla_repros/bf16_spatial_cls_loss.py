@@ -20,8 +20,14 @@ PARITY.md "A second partitioner miscompilation"):
   so part of the miscompilation is in the partitioned model backward.
 
 NOT yet minimized below "this model" — unlike the sibling strided-conv
-repro, the trigger needs the wide bf16 model with both loss terms.  Run
-on the 8-virtual-device CPU backend (jax 0.9.0):
+repro, the trigger needs the wide bf16 model with both loss terms.
+Four bottom-up reconstructions were tried and all stay CLEAN (round 4):
+a 3-conv two-branch net; a depth-4 SHARED head applied over 5
+pyramid levels; an FPN with nearest-upsample + lateral adds; and f32
+master params cast to bf16 per conv with per-image loss normalization —
+so the trigger additionally needs something in the real backbone
+structure (bottleneck residuals and/or the norm layers).  Run on the
+8-virtual-device CPU backend (jax 0.9.0):
 
     python scripts/xla_repros/bf16_spatial_cls_loss.py
 

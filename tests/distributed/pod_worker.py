@@ -72,7 +72,9 @@ def main(
         model, optax.sgd(1e-2, momentum=0.9), (1, *hw, 3), jax.random.key(0)
     )
 
-    global_batch = 8
+    # One image per (virtual) device, whatever the world size: 8 at the
+    # 2-process world, 16 at the 4-process one.
+    global_batch = 4 * num_processes
     local = global_batch // num_processes
 
     def stream():
@@ -97,13 +99,14 @@ def main(
             )
 
     if flavor == "spatial":
-        # 2-D data x space mesh SPANNING both processes (VERDICT r3
+        # 2-D data x space mesh SPANNING all processes (VERDICT r3
         # missing #2: --spatial-shards had only ever run single-process).
         # space=2 stays within each host's 4 devices (the make_mesh_2d
         # guard) and inside the supported sharding envelope
         # (train/step.py::make_train_step_spatial): each host's 2x2 device
-        # block holds 2 data rows x 2 H-halves of its own images.
-        mesh = make_mesh_2d(4, 2)
+        # block holds 2 data rows x 2 H-halves of its own images.  Sized
+        # from the world so any nprocs works, not just 2.
+        mesh = make_mesh_2d(2 * num_processes, 2)
     else:
         mesh = make_mesh()  # all 8 global devices, 1-D data
     state = run_training(

@@ -1,11 +1,12 @@
-"""Multi-process pod bring-up test: 2 'hosts' x 4 virtual devices.
+"""Multi-process pod bring-up tests: N 'hosts' x 4 virtual devices each.
 
 The reference stack could not test its launch layer without an Azure
 cluster (SURVEY.md §4 'Distributed testing: none'); here the
-jax.distributed coordinator path — the mpirun/MPI replacement — runs as two
-real OS processes on CPU, and both must finish training with IDENTICAL
-replicated params (the correctness claim behind 'no broadcast callback
-needed').
+jax.distributed coordinator path — the mpirun/MPI replacement — runs as
+real OS processes on CPU (2-rank worlds for every step flavor, plus a
+4-rank / 16-device world), and every rank must finish training with
+IDENTICAL replicated params (the correctness claim behind 'no broadcast
+callback needed').
 """
 
 import json
@@ -25,6 +26,75 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+def _world_env(work_dir) -> dict:
+    """Worker env: repo on PYTHONPATH, PRIVATE per-world compilation cache.
+
+    The shared session cache must be excluded — it can hold XLA:CPU AOT
+    entries whose target-machine features don't match what a Gloo-enabled
+    process expects (each mismatched entry costs a failed-load + recompile,
+    widening inter-process skew against Gloo's ~30 s collective timeout).
+    """
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "JAX_COMPILATION_CACHE_DIR")
+    }
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo_root, env.get("PYTHONPATH")) if p
+    )
+    env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(str(work_dir), "jax_cache")
+    return env
+
+
+def _communicate_all(procs, timeout: int = 600) -> list[str]:
+    """communicate() every rank; on a timeout, kill ALL survivors so a
+    stalled collective cannot leak orphaned ranks into the session."""
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=timeout)[0].decode())
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        raise
+    return outs
+
+
+def _run_bringup_world(tmp_path, flavor: str, nprocs: int) -> list[dict]:
+    """Launch ``nprocs`` OS-process ranks of pod_worker; return results."""
+    coordinator = f"127.0.0.1:{free_port()}"
+    env = _world_env(tmp_path)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, coordinator, str(nprocs), str(i),
+             str(tmp_path), flavor],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        for i in range(nprocs)
+    ]
+    outs = _communicate_all(procs)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+
+    results = []
+    for i in range(nprocs):
+        with open(tmp_path / f"result_{i}.json") as f:
+            results.append(json.load(f))
+    assert all(r["step"] == 3 for r in results)
+    # Replicated state must be identical across hosts (psum'd grads, same
+    # init PRNG) — the property Horovod needed broadcast callbacks for.
+    # Quantized flavor included: every process dequantizes the same
+    # gathered bytes, so bitwise cross-host equality must still hold.
+    assert len({r["param_sum"] for r in results}) == 1
+    return results
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("flavor", ["plain", "quantized", "spatial"])
 def test_two_process_pod(tmp_path, flavor):
@@ -34,46 +104,17 @@ def test_two_process_pod(tmp_path, flavor):
     trains on a 2-D data x space mesh spanning both processes' devices —
     with ZeRO's own ckpt/resume world below, all FOUR flavors now have
     real multi-process coverage."""
-    coordinator = f"127.0.0.1:{free_port()}"
-    env = {
-        k: v
-        for k, v in os.environ.items()
-        # Isolate from the shared session compilation cache (it can hold
-        # AOT entries whose target-machine features don't match what a
-        # Gloo-enabled process expects — see _run_world's comment).
-        if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "JAX_COMPILATION_CACHE_DIR")
-    }
-    repo_root = os.path.dirname(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    )
-    env["PYTHONPATH"] = os.pathsep.join(
-        p for p in (repo_root, env.get("PYTHONPATH")) if p
-    )
-    env["JAX_COMPILATION_CACHE_DIR"] = str(tmp_path / "jax_cache")
-    procs = [
-        subprocess.Popen(
-            [sys.executable, _WORKER, coordinator, "2", str(i), str(tmp_path),
-             flavor],
-            env=env,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-        )
-        for i in range(2)
-    ]
-    outs = [p.communicate(timeout=600)[0].decode() for p in procs]
-    for p, out in zip(procs, outs):
-        assert p.returncode == 0, f"worker failed:\n{out[-3000:]}"
+    _run_bringup_world(tmp_path, flavor, nprocs=2)
 
-    results = []
-    for i in range(2):
-        with open(tmp_path / f"result_{i}.json") as f:
-            results.append(json.load(f))
-    assert results[0]["step"] == results[1]["step"] == 3
-    # Replicated state must be identical across hosts (psum'd grads, same
-    # init PRNG) — the property Horovod needed broadcast callbacks for.
-    # Quantized flavor included: every process dequantizes the same
-    # gathered bytes, so bitwise cross-host equality must still hold.
-    assert results[0]["param_sum"] == results[1]["param_sum"]
+
+@pytest.mark.slow
+def test_four_process_pod(tmp_path):
+    """4-host bring-up (16 virtual devices): the collective schedule over
+    >2 ranks is a genuinely different Gloo/XLA code path from the
+    pairwise 2-rank ring, and the compile barrier must hold FOUR
+    processes through their cold compiles.  Same bitwise cross-host
+    param-equality contract."""
+    _run_bringup_world(tmp_path, "plain", nprocs=4)
 
 
 _CKPT_WORKER = os.path.join(os.path.dirname(__file__), "pod_ckpt_eval_worker.py")
@@ -91,25 +132,7 @@ class _GlooSkewError(AssertionError):
 
 
 def _run_world(worker, work_dir, phase, flavor="plain"):
-    env = {
-        k: v
-        for k, v in os.environ.items()
-        # JAX_COMPILATION_CACHE_DIR must NOT be the shared session cache:
-        # it can hold XLA:CPU AOT entries compiled with different
-        # target-machine features (Gloo-enabled processes compile with
-        # +prefer-no-scatter/-gather tuning features that single-process
-        # entries lack); each mismatched entry costs a failed-load +
-        # recompile, widening the inter-process skew that trips the Gloo
-        # timeout.  A PRIVATE per-attempt cache is substituted below.
-        if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "JAX_COMPILATION_CACHE_DIR")
-    }
-    repo_root = os.path.dirname(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    )
-    env["PYTHONPATH"] = os.pathsep.join(
-        p for p in (repo_root, env.get("PYTHONPATH")) if p
-    )
-    env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(str(work_dir), "jax_cache")
+    env = _world_env(work_dir)  # private per-attempt compilation cache
     coordinator = f"127.0.0.1:{free_port()}"
     procs = [
         subprocess.Popen(
@@ -121,7 +144,7 @@ def _run_world(worker, work_dir, phase, flavor="plain"):
         )
         for i in range(2)
     ]
-    outs = [p.communicate(timeout=600)[0].decode() for p in procs]
+    outs = _communicate_all(procs)
     failing = [out for p, out in zip(procs, outs) if p.returncode]
     # Classify as benign skew only when EVERY failing worker shows the
     # Gloo signature: a real crash on one rank also kills its peer with

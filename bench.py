@@ -19,6 +19,11 @@ all three and reports ``per_bucket`` imgs/s/chip plus ``weighted_mix``,
 the COCO-aspect-share-weighted rate (shares below).  ``value`` stays the
 flagship 800x1344 number so round-over-round comparisons hold.
 BENCH_SWEEP=0 restores the single-bucket bench.
+
+In sweep mode the flagship-only line prints FIRST and the full line
+(same schema + sweep keys) LAST: any consumer that reads either the
+first or the last JSON line gets a valid record, even if the process is
+killed mid-sweep.
 """
 
 from __future__ import annotations
@@ -244,6 +249,11 @@ def main() -> None:
     }
 
     if sweep:
+        # Print the flagship-only line BEFORE the (minutes-long) sweep of
+        # the other buckets: a consumer that reads the LAST line gets the
+        # full sweep result, while a harness that kills the process on a
+        # timeout still finds a complete, valid flagship line.
+        print(json.dumps(out), flush=True)
         buckets = sweep_buckets()
         per_bucket = {f"{BUCKET[0]}x{BUCKET[1]}": value}
         rates = {BUCKET: ips}

@@ -108,7 +108,7 @@ def main(
         # from the world so any nprocs works, not just 2.
         mesh = make_mesh_2d(2 * num_processes, 2)
     else:
-        mesh = make_mesh()  # all 8 global devices, 1-D data
+        mesh = make_mesh()  # all 4*nprocs global devices, 1-D data
     state = run_training(
         model, state, stream(), 3,
         LoopConfig(total_steps=3, log_every=0), mesh=mesh,

@@ -50,17 +50,25 @@ def _world_env(work_dir) -> dict:
 
 
 def _communicate_all(procs, timeout: int = 600) -> list[str]:
-    """communicate() every rank; on a timeout, kill ALL survivors so a
-    stalled collective cannot leak orphaned ranks into the session."""
+    """communicate() every rank; on a timeout, kill AND REAP all
+    survivors (no zombies, no leaked collectives) and re-raise with the
+    ranks' output tails attached — the Gloo/XLA stall signature lives in
+    the merged stdout and would otherwise be discarded."""
     outs = []
     try:
         for p in procs:
             outs.append(p.communicate(timeout=timeout)[0].decode())
-    except subprocess.TimeoutExpired:
-        for p in procs:
+    except subprocess.TimeoutExpired as e:
+        tails = []
+        for i, p in enumerate(procs):
             if p.poll() is None:
                 p.kill()
-        raise
+            out = p.communicate()[0].decode()  # reaps; collects the tail
+            tails.append(f"--- rank {i} tail ---\n{out[-1500:]}")
+        raise AssertionError(
+            f"world timed out after {timeout}s; rank outputs:\n"
+            + "\n".join(tails)
+        ) from e
     return outs
 
 

@@ -351,7 +351,12 @@ def _degenerate_strided_conv_heights(
     """
     if num_space < 8:
         return []
-    heights = [image_h // d for d in (4, 8, 16, 32, 64)]
+    # Ceil division: the stride-2 downsample chain produces ceil(H/d)
+    # extents (SAME padding), and at the zone's lower edge floor is one
+    # row short — e.g. H=224, 8 shards: floor gives 3 (outside [4, 16))
+    # but the real P7 input is ceil(224/64)=4, the measured-wrong
+    # 0.5-rows-per-shard layout.
+    heights = [-(-image_h // d) for d in (4, 8, 16, 32, 64)]
     return [h for h in heights if num_space / 2 <= h < 2 * num_space]
 
 

@@ -68,11 +68,15 @@ def sweep_buckets() -> tuple[tuple[tuple[int, int], float], ...]:
     )
 
     buckets = default_buckets(800, 1333)
-    assert buckets[0] == BUCKET, (
-        f"default_buckets(800, 1333) now leads with {buckets[0]}, not "
-        f"{BUCKET} — update BUCKET (the round-over-round headline shape) "
-        "and _MIX_SHARES together"
-    )
+    # Runtime schema checks, not debug asserts: under `python -O` a bare
+    # assert would vanish and a reordered default_buckets could silently
+    # pair shares with the wrong shapes.
+    if buckets[0] != BUCKET:
+        raise RuntimeError(
+            f"default_buckets(800, 1333) now leads with {buckets[0]}, not "
+            f"{BUCKET} — update BUCKET (the round-over-round headline "
+            "shape) and _MIX_SHARES together"
+        )
     if len(buckets) == 1:
         return ((buckets[0], 1.0),)
 
@@ -81,10 +85,11 @@ def sweep_buckets() -> tuple[tuple[tuple[int, int], float], ...]:
         return "landscape" if h < w else ("portrait" if h > w else "square")
 
     classes = [aspect_class(b) for b in buckets]
-    assert sorted(classes) == sorted(_MIX_SHARES), (
-        f"default_buckets aspect classes {classes} no longer match the "
-        f"share table {sorted(_MIX_SHARES)} — update _MIX_SHARES"
-    )
+    if sorted(classes) != sorted(_MIX_SHARES):
+        raise RuntimeError(
+            f"default_buckets aspect classes {classes} no longer match the "
+            f"share table {sorted(_MIX_SHARES)} — update _MIX_SHARES"
+        )
     return tuple((b, _MIX_SHARES[c]) for b, c in zip(buckets, classes))
 
 

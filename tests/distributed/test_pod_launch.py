@@ -50,14 +50,20 @@ def _world_env(work_dir) -> dict:
 
 
 def _communicate_all(procs, timeout: int = 600) -> list[str]:
-    """communicate() every rank; on a timeout, kill AND REAP all
-    survivors (no zombies, no leaked collectives) and re-raise with the
-    ranks' output tails attached — the Gloo/XLA stall signature lives in
-    the merged stdout and would otherwise be discarded."""
+    """communicate() every rank against ONE shared deadline (a per-rank
+    timeout would let a multi-rank hang stall nprocs*timeout before
+    failing); on expiry, kill AND REAP all survivors (no zombies, no
+    leaked collectives) and re-raise with the ranks' output tails
+    attached — the Gloo/XLA stall signature lives in the merged stdout
+    and would otherwise be discarded."""
+    import time
+
+    deadline = time.monotonic() + timeout
     outs = []
     try:
         for p in procs:
-            outs.append(p.communicate(timeout=timeout)[0].decode())
+            remaining = max(0.0, deadline - time.monotonic())
+            outs.append(p.communicate(timeout=remaining)[0].decode())
     except subprocess.TimeoutExpired as e:
         tails = []
         for i, p in enumerate(procs):

@@ -348,6 +348,14 @@ def _degenerate_strided_conv_heights(
     layout at <= 4 shards (including exactly 1 row/shard, which IS broken
     at 8 shards; the boundary is shard-count-dependent, so the canary test
     pins both sides of it).
+
+    Round-5 16-shard sweep (strided_conv_weight_grad.py --probe, pinned
+    by test_xla_strided_conv_grad_canary_16shard): broken layouts at 16
+    shards are rows/shard in {0.5, 1} (44%/41%), with 1.5, 2 and the
+    replicated 0.25 rows exact — every broken layout falls inside this
+    zone, so the [n/2, 2n) rule is MEASURED (as a conservative superset;
+    1.5 rows over-refuses) at 4, 8 and 16 shards rather than
+    extrapolated.
     """
     if num_space < 8:
         return []

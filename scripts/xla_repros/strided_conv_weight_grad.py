@@ -13,20 +13,56 @@ stride 1, >=2 rows per shard, and 4 shards at one row per shard — the
 boundary is shard-count-dependent.  Forward values and the grad-input
 are exact in every probed config; only grad-weight is wrong.
 
+16-shard sweep (round 5, run via ``--probe``, pinned by
+tests/distributed/test_spatial_train.py::test_xla_strided_conv_grad_canary_16shard):
+
+    rows/shard   0.25    0.5     1.0     1.5     2.0     4.0
+    16 shards    exact   44%     41%     exact   exact   exact
+     8 shards    —       exact*  44%     exact   exact   exact
+     4 shards    —       exact   exact   exact   exact   exact
+
+(*) single-op repro only: round-4 MODEL-level probes measured 1e-4-class
+parameter error at 0.5 rows/shard on 8 shards, so the model guard's
+[0.5, 2)-rows zone is kept as the conservative union of both probes.
+Every layout the single-op sweep finds broken lies inside that zone at
+both 8 and 16 shards — the zone generalizes as a superset, with the
+1.5-rows row measured exact (over-refusal, accepted: the cost is only a
+smaller --spatial-shards).  Sub-half-row layouts (H < shards/2) are
+handled by replication and exact.
+
 Run:  python scripts/xla_repros/strided_conv_weight_grad.py [shardy]
+      # custom sweep (shards:H pairs; device count auto-raised):
+      python scripts/xla_repros/strided_conv_weight_grad.py \\
+          --json --probe 16:8 16:16 16:24 16:32
 
 This is the bug behind `make_train_step_spatial`'s sharding-envelope
 guard (batchai_retinanet_horovod_coco_tpu/train/step.py) and is pinned
 by tests/distributed/test_spatial_train.py::test_xla_strided_conv_grad_canary.
 """
 
+import json
 import os
 import sys
 
+# Device count must be fixed BEFORE importing jax: parse --probe first so
+# a 16-shard sweep gets a 16-device host platform.
+_probes = []
+_args = sys.argv[1:]
+if "--probe" in _args:
+    for a in _args[_args.index("--probe") + 1 :]:
+        if ":" not in a:
+            break
+        s, h = a.split(":")
+        _probes.append((int(s), int(h)))
+_ndev = max([8] + [s for s, _ in _probes])
+
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "")
-    + " --xla_force_host_platform_device_count=8"
+    + f" --xla_force_host_platform_device_count={_ndev}"
 ).strip()
+# A shared compilation cache may hold entries from a differently-flagged
+# interpreter; this script is tiny, always compile fresh.
+os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
 
 import jax
 
@@ -52,25 +88,46 @@ def rel_diff(shards: int, H: int, k: int = 3, stride: int = 2) -> float:
     Ho = (H + stride - 1) // stride
     cot = rng.normal(0, 1, (2, Ho, Ho, C))
     pad = ((k // 2, k // 2), (k // 2, k // 2))
+    xsh = NamedSharding(mesh, P("data", "space"))
+    rep = NamedSharding(mesh, P())
 
-    def loss(w, x):
+    def loss_ref(w, x):
         y = jax.lax.conv_general_dilated(
             x, w, (stride, stride), pad,
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
         )
         return jnp.sum(y * jnp.asarray(cot))
 
-    g_ref = jax.grad(loss)(jnp.asarray(w), jnp.asarray(x))
-    xsh = NamedSharding(mesh, P("data", "space"))
-    rep = NamedSharding(mesh, P())
-    g_sp = jax.jit(
-        jax.grad(loss), in_shardings=(rep, xsh), out_shardings=rep
-    )(jnp.asarray(w), jax.device_put(jnp.asarray(x), xsh))
+    def loss(w, x):
+        # The shard layout comes from an in-jit constraint (GSPMD pads
+        # non-divisible extents), matching how the model's intermediate
+        # maps are sharded — a device_put would refuse H % shards != 0.
+        return loss_ref(w, jax.lax.with_sharding_constraint(x, xsh))
+
+    g_ref = jax.grad(loss_ref)(jnp.asarray(w), jnp.asarray(x))
+    g_sp = jax.jit(jax.grad(loss), out_shardings=rep)(
+        jnp.asarray(w), jnp.asarray(x)
+    )
     d = float(np.max(np.abs(np.asarray(g_ref) - np.asarray(g_sp))))
     return d / float(np.max(np.abs(np.asarray(g_ref))))
 
 
 if __name__ == "__main__":
+    if _probes:
+        results = [
+            {"shards": s, "H": h, "rows_per_shard": h / s,
+             "rel": rel_diff(shards=s, H=h)}
+            for s, h in _probes
+        ]
+        if "--json" in sys.argv[1:]:
+            print(json.dumps(results))
+        else:
+            for r in results:
+                print(f"{r['shards']} shards, H={r['H']} "
+                      f"({r['rows_per_shard']:.2f} rows/shard): "
+                      f"rel diff {r['rel']:.3e}")
+        sys.exit(0)
+
     print(f"jax {jax.__version__}; shardy={'shardy' in sys.argv[1:]}")
     bad = rel_diff(shards=8, H=8)
     print(f"8 shards, H=8 (1 row/shard), k=3 s=2: rel diff {bad:.3e}  "

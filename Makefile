@@ -18,7 +18,8 @@ DRY ?=
 DRYFLAG = $(if $(DRY),--dry-run,)
 CLUSTER = python -m batchai_retinanet_horovod_coco_tpu.launch.cluster
 
-.PHONY: create submit status delete test smoke bench
+.PHONY: create submit status delete test test-timings smoke bench \
+	bench-check bench-pipeline convergence-full
 
 create:
 	$(CLUSTER) create --name $(NAME) --zone $(ZONE) --accelerator $(ACCEL) $(DRYFLAG)
@@ -51,6 +52,11 @@ smoke:
 
 bench:
 	python bench.py
+
+# Regression tripwire: flagship-bucket bench vs the committed
+# BUCKETBENCH.json number minus the 3% noise band (exit 1 on regression).
+bench-check:
+	BENCH_SWEEP=0 BENCH_CHECK=1 python bench.py
 
 bench-pipeline:
 	python bench_pipeline.py

@@ -152,16 +152,28 @@ def round_up(x: int, multiple: int) -> int:
 def default_buckets(min_side: int, max_side: int) -> tuple[tuple[int, int], ...]:
     """Static (H, W) shape buckets covering the resize rule's output range.
 
-    The single source of truth for bucket derivation — train.py and debug.py
-    both consume this, so the shapes the tools report match the shapes the
-    train step compiles for.
+    The single source of truth for bucket derivation — train.py, debug.py
+    and bench.py all consume this, so the shapes the tools report match the
+    shapes the train step compiles for.
+
+    Two buckets suffice, PROVABLY: ``resize_scale`` maps every source to
+    resized dims with min(rh, rw) <= min_side <= lo and max(rh, rw) <=
+    max_side <= hi, so a landscape/square result (rh <= rw) always fits
+    (lo, hi) and a portrait result fits (hi, lo).  Rounds 1-4 carried a
+    third round_up((lo+hi)/2) "mid" square bucket for mild portraits; the
+    round-5 exhaustive source-size scan (tests/unit/test_buckets.py)
+    showed it is UNREACHABLE under that argument for every config — and
+    for the images it targeted the portrait bucket pads less anyway
+    (933x800 resized: 0.33 Mpx waste in 1344x800 vs 0.44 in 1088x1088).
+    Dropping it removes a dead compiled program per run (one fewer
+    ~minutes-long bucket compile at pod bring-up, a third off the bench
+    sweep) and a phantom 4% share in the weighted-mix arithmetic.
     """
     lo = round_up(min_side, 32)
     hi = round_up(max_side, 32)
     if lo == hi:
         return ((lo, lo),)
-    mid = round_up((lo + hi) // 2, 32)
-    return ((lo, hi), (hi, lo), (mid, mid))
+    return ((lo, hi), (hi, lo))
 
 
 def resize_scale(h: int, w: int, min_side: int, max_side: int) -> float:

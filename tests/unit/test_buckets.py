@@ -1,0 +1,132 @@
+"""Bucket routing contracts (VERDICT r4 weak #4).
+
+The bench's weighted-mix arithmetic keys COCO shares by aspect class;
+these tests tie that keying to the pipeline's ACTUAL routing
+(``bucket_for_source`` = resize rule + rounding + ``pick_bucket``), so a
+bucket-list change that de-syncs the weighted bench number from reality
+fails here instead of silently skewing BENCH artifacts.
+
+The exhaustive scan is also what exposed (round 5) that the former
+third 1088x1088 "mid" bucket was unreachable: every resized image has
+min dim <= lo and max dim <= hi, so one of the two orientation buckets
+always fits — the phantom bucket cost a dead multi-minute compile per
+run and a 4% phantom share.
+"""
+
+import sys
+
+import pytest
+
+sys.path.insert(0, "/root/repo")
+
+from batchai_retinanet_horovod_coco_tpu.data.pipeline import (  # noqa: E402
+    bucket_for_source,
+    default_buckets,
+)
+
+FLAGSHIP = (800, 1333)
+
+
+def _aspect_class(hw):
+    h, w = hw
+    return "landscape" if h < w else ("portrait" if h > w else "square")
+
+
+def _source_grid():
+    """Source sizes covering the COCO range plus adversarial extremes
+    (tiny, huge, near-square both ways, pathological aspect ratios)."""
+    sizes = [
+        (h, w)
+        for h in range(40, 1500, 97)
+        for w in range(40, 1500, 89)
+    ]
+    sizes += [
+        (500, 500), (640, 480), (480, 640), (639, 640), (640, 639),
+        (1, 10000), (10000, 1), (3000, 3000), (16, 16), (801, 800),
+        (800, 801),
+    ]
+    return sizes
+
+
+def test_every_bucket_is_reachable():
+    """Anti-dead-bucket contract: each bucket the pipeline compiles a
+    program for must be the routing target of SOME source size — a
+    bucket no image can reach is pure compile-time waste (the round-5
+    finding this test pins)."""
+    buckets = default_buckets(*FLAGSHIP)
+    hit = {
+        bucket_for_source(h, w, *FLAGSHIP, buckets)
+        for h, w in _source_grid()
+    }
+    assert hit == set(buckets), (
+        f"unreachable bucket(s): {set(buckets) - hit}"
+    )
+
+
+def test_routing_matches_bench_aspect_class_keying():
+    """bench.py pairs each bucket with a COCO share via the bucket's
+    aspect class (landscape/portrait); the pipeline must actually route
+    landscape AND square sources to the landscape bucket and portrait
+    sources to the portrait bucket, for every source size."""
+    buckets = default_buckets(*FLAGSHIP)
+    for h, w in _source_grid():
+        target = bucket_for_source(h, w, *FLAGSHIP, buckets)
+        want = "portrait" if h > w else "landscape"
+        assert _aspect_class(target) == want, (
+            f"source {h}x{w} ({_aspect_class((h, w))}) routed to "
+            f"{target} ({_aspect_class(target)}), bench keys its share "
+            f"as {want}"
+        )
+
+
+def test_bench_sweep_buckets_cover_pipeline_buckets():
+    """bench.sweep_buckets' (bucket, share) pairs: same bucket list as
+    the pipeline, every share keyed to the class the routing scan above
+    validates, shares summing to 1."""
+    bench = pytest.importorskip("bench")
+
+    pairs = bench.sweep_buckets()
+    assert [b for b, _ in pairs] == list(default_buckets(*FLAGSHIP))
+    assert abs(sum(s for _, s in pairs) - 1.0) < 1e-9
+    for b, share in pairs:
+        assert share == bench._MIX_SHARES[_aspect_class(b)]
+
+
+def test_debug_buckets_shares_agree_with_pick_bucket(tmp_path):
+    """`debug.py buckets` (the operator's exact-share tool) and the
+    pipeline's own router must produce identical shares for the same
+    annotation metadata — the bench's re-derive-exactly instruction
+    assumes they agree."""
+    import json
+
+    import debug
+
+    dims = [(640, 480), (640, 480), (640, 480), (480, 640), (500, 500)]
+    blob = {
+        "categories": [{"id": 1, "name": "thing"}],
+        "images": [
+            {"id": i, "file_name": f"{i}.jpg", "width": w, "height": h}
+            for i, (h, w) in enumerate(dims)
+        ],
+        "annotations": [
+            {"id": i, "image_id": i, "category_id": 1,
+             "bbox": [1, 1, 10, 10], "area": 100, "iscrowd": 0}
+            for i in range(len(dims))
+        ],
+    }
+    ann = tmp_path / "instances.json"
+    with open(ann, "w") as f:
+        json.dump(blob, f)
+
+    shares = debug.bucket_shares(str(ann), *FLAGSHIP)
+
+    buckets = default_buckets(*FLAGSHIP)
+    expect = {f"{b[0]}x{b[1]}": 0 for b in buckets}
+    for h, w in dims:
+        b = bucket_for_source(h, w, *FLAGSHIP, buckets)
+        expect[f"{b[0]}x{b[1]}"] += 1
+    assert {k: v["count"] for k, v in shares.items()} == expect
+    # Concrete flagship-config expectation for these (h, w) dims: the
+    # three 640x480 portraits -> 1344x800; the 480x640 landscape and
+    # 500x500 square -> 800x1344.
+    assert expect == {"800x1344": 2, "1344x800": 3}

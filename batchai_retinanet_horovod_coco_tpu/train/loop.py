@@ -207,6 +207,7 @@ def run_training(
     logger: MetricLogger | None = None,
     shard_weight_update: bool = False,
     quantized_allreduce: bool = False,
+    allow_data_axis_divergence: bool = False,
 ) -> TrainState:
     """Run ``config.total_steps`` of SPMD training; returns the final state.
 
@@ -329,6 +330,7 @@ def run_training(
                     loss_config=loss_config,
                     matching_config=matching_config,
                     anchor_config=anchor_config,
+                    allow_data_axis_divergence=allow_data_axis_divergence,
                 )
             else:
                 step_fn = step_fns[hw] = make_train_step(

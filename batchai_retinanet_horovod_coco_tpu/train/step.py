@@ -368,6 +368,26 @@ def _degenerate_strided_conv_heights(
     return [h for h in heights if num_space / 2 <= h < 2 * num_space]
 
 
+# Backbones whose spatial-step gradients measured EXACT on the virtual
+# mesh rig (round-5 f64 probes).  Deep backbones are NOT on the list: see
+# make_train_step_spatial's "Data-axis envelope" docstring section.
+_SPATIAL_GRAD_VALIDATED_BACKBONES = frozenset({"resnet_test"})
+
+
+def _data_axis_risky_stage_heights(image_h: int, num_space: int) -> list[int]:
+    """Backbone-stage map heights inside the round-5 residual-chain bug
+    zone: stages run at ceil(H/4..H/32), and the measured model-level
+    divergence (see make_train_step_spatial's "Data-axis envelope")
+    requires some residual-stage map at <= 1 row per shard — hw-64
+    models (min stage rows 0.5-1) diverge at data >= 2 while hw-256
+    models (min 4 rows) measure clean, matching the minimal repro's
+    boundary (1 row broken at space=2; 1.5+ rows exact)."""
+    if num_space < 2:
+        return []
+    heights = [-(-image_h // d) for d in (4, 8, 16, 32)]
+    return [h for h in heights if h <= num_space]
+
+
 def make_train_step_spatial(
     model,
     image_hw: tuple[int, int],
@@ -379,6 +399,7 @@ def make_train_step_spatial(
     donate_state: bool = True,
     allow_degenerate_spatial_sharding: bool = False,
     allow_unvalidated_bf16: bool = False,
+    allow_data_axis_divergence: bool = False,
 ) -> Callable[[TrainState, dict[str, Any]], tuple[TrainState, dict[str, jnp.ndarray]]]:
     """Train step with the IMAGE sharded across chips (spatial partitioning).
 
@@ -438,6 +459,29 @@ def make_train_step_spatial(
     step of this factory's output against ``make_train_step(mesh=None)``
     on an identical batch first — the canary shows exactly how).
 
+    Data-axis envelope (round-5 finding): on DEEP backbones whose
+    small stages land at <= 1 row per shard, combining a data axis >= 2
+    with space sharding makes the compiled backward diverge from the
+    single-device gradients — measured per-step param error (f64,
+    reduced-width resnet50, hw 64, so NOT rounding): L2 4.1e-6 at
+    data=1, 2.8e-4 at data=2, 6.5e-4 at 4, 2.1e-3 at 8, 7.2e-3 at 16
+    (~x3 per data doubling; identical at space=2 and space=4).  The
+    minimal trigger is >= 2 chained residual blocks of 3x3 convs on an
+    H=2 map at (data>=2, space=2) — FD-proven wrong backward, up to
+    4.1e5x relative error
+    (scripts/xla_repros/spatial_residual_chain_grad.py; canary:
+    test_spatial_train.py::test_xla_spatial_data_axis_grad_canary).
+    Clean by measurement: the shallow CI backbone everywhere,
+    ``(data, 1)`` meshes (bit-exact), pure-spatial ``(1, space)``
+    meshes (4e-6-class), and — key for real workloads — the SAME deep
+    model at hw 256, where every stage runs >= 4 rows/shard (param L2
+    5.6e-8 at (4, 2)); flagship 800-class buckets keep every stage
+    >= 3 rows/shard at space <= 4 and are therefore outside the zone.
+    The factory refuses data >= 2 only when some backbone-stage height
+    lands at <= 1 row per shard (``_data_axis_risky_stage_heights``)
+    on a non-shallow backbone; ``allow_data_axis_divergence=True``
+    overrides (the dryrun uses it to pin the divergence magnitude).
+
     Pallas kernels are opaque to GSPMD and cannot be spatially
     partitioned: the fused assignment is forced off (the vmapped XLA
     matching path partitions fine) and a ``pallas_focal`` loss config is
@@ -476,6 +520,32 @@ def make_train_step_spatial(
                 "allow_degenerate_spatial_sharding=True to accept "
                 "1e-3-class gradient error in the affected conv kernels"
             )
+    num_data = dict(mesh.shape).get(DATA_AXIS, 1)
+    risky_stage = _data_axis_risky_stage_heights(image_hw[0], num_space)
+    if (
+        num_space > 1
+        and num_data > 1
+        and risky_stage
+        and model.config.backbone not in _SPATIAL_GRAD_VALIDATED_BACKBONES
+        and not allow_data_axis_divergence
+    ):
+        raise ValueError(
+            f"spatial mesh (data={num_data}, space={num_space}) with "
+            f"backbone {model.config.backbone!r} at image height "
+            f"{image_hw[0]} is refused: backbone-stage maps of height "
+            f"{risky_stage} land at <= 1 row per shard, where the "
+            "partitioned backward of deep (residual-chain) backbones "
+            "diverges from the single-device gradients once the data "
+            "axis exceeds 1 (measured f64: 2.8e-4 per-step param L2 at "
+            "data=2 growing ~3x per doubling — see "
+            "make_train_step_spatial's 'Data-axis envelope').  Use a "
+            "pure-spatial (1, space) mesh (device count equal to the "
+            "spatial shard count), larger images (flagship 800-class "
+            "buckets keep every stage >= 3 rows/shard at space <= 4 and "
+            "measure clean), a plain DP mesh, or pass "
+            "allow_data_axis_divergence=True to accept the measured "
+            "gradient error"
+        )
     if loss_config.pallas_focal:
         raise ValueError(
             "pallas_focal is incompatible with spatial partitioning: a "

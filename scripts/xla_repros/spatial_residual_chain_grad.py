@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Standalone repro: XLA SPMD miscompiles the backward of a residual
+conv chain on tiny H-sharded maps over a 2-D (data, space) mesh.
+
+THE MINIMAL TRIGGER (~25 lines, pure lax, f64 so rounding is ruled
+out): >= 2 chained residual blocks ``x + conv2(relu(conv1(x)))`` of 3x3
+stride-1 SAME convs on an H=2 feature map, input sharded
+P('data', 'space') with space=2 (one H row per shard) and data >= 2.
+The weight gradients under the partitioner then diverge from the
+unsharded gradients CATASTROPHICALLY, exploding with both chain length
+and data-axis width (relative L2 error, jax 0.9.0 CPU backend, f64):
+
+    blocks:      1        2        4
+    (8,2) H=2    exact    1.9      6.7e3
+
+    data:        2        4        8        16        (4 blocks, H=2)
+    (d,2) H=2    3.0      1.5e2    6.7e3    4.1e5
+
+    neighbours measured EXACT (<=1e-15): H=1 (0.5 rows/shard), H=3
+    (1.5 rows), H=4 (2 rows); space=4 at H=4 (1 row/shard!); data=1
+    at any probed H; the chain without the residual add; a single
+    block; every single-conv probe (see strided_conv_weight_grad.py).
+
+Finite-difference proof that the BACKWARD (not the forward) is wrong —
+run on the full-depth ResNet variant of this trigger, differencing
+through the sharded executable's own forward:
+
+    fd (through SHARDED forward)  +6.875e+01
+    unsharded autodiff gradient   +6.898e+01
+    SHARDED autodiff gradient     +1.641e+06      (~24,000x too large)
+
+Model-level impact (what led here, round 5): the spatially partitioned
+RetinaNet train step on DEEP backbones (stacked residual blocks at the
+H/16, H/32 stages, which hit these tiny-map geometries on small CI
+images) computes wrong gradients whenever the mesh has data >= 2 —
+measured per-step param L2 error 2.8e-4 (data=2) to 7.2e-3 (data=16)
+at hw 64, f64-persistent — while the 1-block-per-stage CI backbone,
+(data, 1) meshes, and (1, space) pure-spatial meshes measure exact.
+The composed model diverges in MORE configs than this minimal trigger
+(e.g. space=4 at hw 64), so the framework guards on the measured
+model-level envelope, not just this op pattern
+(train/step.py::make_train_step_spatial "Data-axis envelope").
+
+Canary: tests/distributed/test_spatial_train.py::
+test_xla_spatial_data_axis_grad_canary (asserts the bug is PRESENT —
+its failure after a jax upgrade is the signal to re-measure and relax
+the guards).
+
+Run:  python scripts/xla_repros/spatial_residual_chain_grad.py [--json]
+"""
+
+import json
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=32"
+).strip()
+os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def _conv(x, w):
+    return jax.lax.conv_general_dilated(
+        x, w, (1, 1), ((1, 1), (1, 1)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def rel_diff(
+    data: int, space: int, H: int, blocks: int, residual: bool = True
+) -> float:
+    """Relative L2 error of the sharded weight grads vs unsharded."""
+    mesh = Mesh(
+        np.array(jax.devices()[: data * space]).reshape(data, space),
+        axis_names=("data", "space"),
+    )
+    rng = np.random.default_rng(0)
+    C = 16
+    B = max(2, data)
+    x = rng.normal(0, 1, (B, H, H, C))
+    ws = [rng.normal(0, 0.1, (3, 3, C, C)) for _ in range(2 * blocks)]
+    cot = rng.normal(0, 1, (B, H, H, C))
+    xsh = NamedSharding(mesh, P("data", "space"))
+    rep = NamedSharding(mesh, P())
+
+    def net(ws, x):
+        for i in range(blocks):
+            h = _conv(jax.nn.relu(_conv(x, ws[2 * i])), ws[2 * i + 1])
+            x = x + h if residual else h
+        return jnp.sum(x * jnp.asarray(cot))
+
+    def net_sharded(ws, x):
+        return net(ws, jax.lax.with_sharding_constraint(x, xsh))
+
+    args = [jnp.asarray(w) for w in ws]
+    g_ref = jax.grad(net)(args, jnp.asarray(x))
+    g_sp = jax.jit(jax.grad(net_sharded), out_shardings=rep)(
+        args, jnp.asarray(x)
+    )
+    num = sum(
+        float(np.sum((np.asarray(p) - np.asarray(q)) ** 2))
+        for p, q in zip(g_sp, g_ref)
+    )
+    den = sum(float(np.sum(np.asarray(p) ** 2)) for p in g_ref)
+    return (num / den) ** 0.5
+
+
+if __name__ == "__main__":
+    rows = []
+    print(f"jax {jax.__version__}; 32 virtual CPU devices; f64")
+    for data, space, H, blocks, label in [
+        (8, 2, 2, 2, "THE TRIGGER: 2 residual blocks, 1 row/shard"),
+        (8, 2, 2, 4, "4 blocks (explodes with depth)"),
+        (2, 2, 2, 4, "data=2 (minimum data width)"),
+        (8, 2, 2, 1, "1 block: exact"),
+        (8, 2, 4, 4, "2 rows/shard: exact"),
+        (8, 2, 3, 4, "1.5 rows/shard: exact"),
+        (8, 4, 4, 4, "space=4 at 1 row/shard: exact"),
+        (1, 2, 2, 4, "data=1: exact"),
+    ]:
+        r = rel_diff(data, space, H, blocks)
+        rows.append({"data": data, "space": space, "H": H,
+                     "blocks": blocks, "rel": r})
+        flag = "  <== WRONG" if r > 1e-6 else ""
+        print(f"({data},{space}) H={H} blocks={blocks} [{label}]: "
+              f"rel {r:.3e}{flag}")
+    no_res = rel_diff(8, 2, 2, 4, residual=False)
+    rows.append({"data": 8, "space": 2, "H": 2, "blocks": 4,
+                 "residual": False, "rel": no_res})
+    print(f"(8,2) H=2 blocks=4 WITHOUT residual add: rel {no_res:.3e}")
+    if "--json" in sys.argv[1:]:
+        print(json.dumps(rows))

@@ -400,6 +400,49 @@ def test_make_mesh_2d_guards_space_spanning_hosts():
     assert make_mesh_2d(4, 2) is not None
 
 
+def test_spatial_guard_refuses_deep_backbone_data_axis():
+    """Round-5 data-axis envelope: deep-backbone spatial training with
+    data >= 2 is refused when a backbone stage lands at <= 1 row per
+    shard (measured divergent gradients — see the residual-chain canary
+    above); pure-spatial (1, space) meshes, realistic image sizes
+    (every stage >= 2 rows/shard, measured clean at hw 256), and the
+    explicit override stay available."""
+    from batchai_retinanet_horovod_coco_tpu.train.step import (
+        _data_axis_risky_stage_heights,
+    )
+
+    cfg = RetinaNetConfig(
+        num_classes=NUM_CLASSES, backbone="resnet50", fpn_channels=32,
+        head_width=32, head_depth=1, dtype=jnp.float32,
+    )
+    model = build_retinanet(cfg)
+    # 64px images: stage5 runs at H=2 -> 1 row/shard at space=2.
+    with pytest.raises(ValueError, match="row per shard"):
+        make_train_step_spatial(
+            model, HW, NUM_CLASSES, mesh=make_mesh_2d(2, 2)
+        )
+    # Pure-spatial (1, space): allowed for every backbone.
+    assert make_train_step_spatial(
+        model, HW, NUM_CLASSES, mesh=make_mesh_2d(1, 2)
+    ) is not None
+    # Realistic image sizes keep every stage >= 2 rows/shard: allowed
+    # (flagship 800-class buckets measure clean — hw-256 f64 probe).
+    assert make_train_step_spatial(
+        model, (256, 256), NUM_CLASSES, mesh=make_mesh_2d(2, 2)
+    ) is not None
+    # Explicit opt-in: allowed.
+    assert make_train_step_spatial(
+        model, HW, NUM_CLASSES, mesh=make_mesh_2d(2, 2),
+        allow_data_axis_divergence=True,
+    ) is not None
+    # The zone helper itself: 64px at space 2 flags the H=2 stage-5 map
+    # (and the H=1... there is none at /32); 800px flags nothing for
+    # space <= 4.
+    assert _data_axis_risky_stage_heights(64, 2) == [2]
+    assert _data_axis_risky_stage_heights(800, 4) == []
+    assert _data_axis_risky_stage_heights(800, 2) == []
+
+
 def test_spatial_guard_refuses_bf16():
     """Non-f32 spatial training is refused by default: the partitioner
     miscompiles the bf16 step at flagship width (see the bf16 canary)."""
@@ -411,6 +454,57 @@ def test_spatial_guard_refuses_bf16():
     with pytest.raises(ValueError, match="bfloat16 model is refused"):
         make_train_step_spatial(
             model, HW, NUM_CLASSES, mesh=make_mesh_2d(2, 4)
+        )
+
+
+@pytest.mark.slow
+def test_xla_spatial_data_axis_grad_canary():
+    """Canary for the round-5 finding: XLA SPMD miscompiles the backward
+    of chained residual conv blocks on tiny H-sharded maps over a 2-D
+    (data>=2, space=2) mesh — the bug behind make_train_step_spatial's
+    data-axis envelope guard.  Runs the committed minimal repro
+    (scripts/xla_repros/spatial_residual_chain_grad.py: f64, pure lax,
+    FD-proven wrong backward) in a 16-device subprocess and asserts BOTH
+    sides: the trigger layouts are broken (an upstream fix flips them —
+    the signal to re-measure and relax the guard) and the neighbouring
+    exact layouts stay exact (an envelope growth flips those)."""
+    import json as _json
+    import subprocess
+    import sys as _sys
+
+    script = os.path.join(
+        os.path.dirname(__file__), "..", "..", "scripts", "xla_repros",
+        "spatial_residual_chain_grad.py",
+    )
+    proc = subprocess.run(
+        [_sys.executable, script, "--json"],
+        capture_output=True, text=True, timeout=1500,
+    )
+    assert proc.returncode == 0, (
+        f"repro script failed (exit {proc.returncode}):\n"
+        f"{proc.stderr[-3000:]}"
+    )
+    rows = _json.loads(proc.stdout.strip().splitlines()[-1])
+    by_key = {
+        (r["data"], r["space"], r["H"], r["blocks"],
+         r.get("residual", True)): r["rel"]
+        for r in rows
+    }
+    broken = [(8, 2, 2, 2, True), (8, 2, 2, 4, True), (2, 2, 2, 4, True)]
+    for k in broken:
+        assert by_key[k] > 0.5, (
+            f"residual-chain sharded backward now MATCHES at {k} "
+            f"(rel {by_key[k]:.2e}) — the upstream bug appears fixed: "
+            "re-run the round-5 model-level probes and, if they are "
+            "clean too, drop make_train_step_spatial's "
+            "allow_data_axis_divergence guard"
+        )
+    exact = [(8, 2, 2, 1, True), (8, 2, 4, 4, True), (8, 2, 3, 4, True),
+             (8, 4, 4, 4, True), (1, 2, 2, 4, True), (8, 2, 2, 4, False)]
+    for k in exact:
+        assert by_key[k] < 1e-6, (
+            f"layout {k} now DIVERGES (rel {by_key[k]:.2e}) — the bug's "
+            "envelope grew; widen the spatial guards"
         )
 
 

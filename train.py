@@ -244,6 +244,12 @@ def build_parser() -> argparse.ArgumentParser:
                             "analogue, SURVEY.md §5.7); must divide "
                             "--num-devices; exclusive with "
                             "--shard-weight-update/--quantized-allreduce")
+        g.add_argument("--allow-data-axis-divergence", action="store_true",
+                       help="accept the measured gradient divergence of "
+                            "deep-backbone spatial training on meshes "
+                            "with a data axis >= 2 (round-5 finding; see "
+                            "make_train_step_spatial's 'Data-axis "
+                            "envelope' docstring)")
         g.add_argument("--distributed-auto", action="store_true",
                        help="jax.distributed.initialize() from TPU metadata")
         g.add_argument("--coordinator-address", default=None)
@@ -466,8 +472,44 @@ def main(argv=None) -> dict[str, float]:
         from batchai_retinanet_horovod_coco_tpu.parallel.mesh import (
             make_mesh_2d,
         )
+        from batchai_retinanet_horovod_coco_tpu.train.step import (
+            _SPATIAL_GRAD_VALIDATED_BACKBONES,
+            _data_axis_risky_stage_heights,
+        )
 
         data_size = num_devices // spatial_shards
+        risky_buckets = {
+            f"{h}x{w}": _data_axis_risky_stage_heights(h, spatial_shards)
+            for h, w in default_buckets(
+                args.image_min_side, args.image_max_side
+            )
+            if _data_axis_risky_stage_heights(h, spatial_shards)
+        }
+        if (
+            data_size > 1
+            and risky_buckets
+            and args.backbone not in _SPATIAL_GRAD_VALIDATED_BACKBONES
+            and not args.allow_data_axis_divergence
+        ):
+            # Round-5 finding: deep-backbone spatial training on meshes
+            # with data >= 2 computes measurably wrong gradients when a
+            # backbone stage lands at <= 1 row per shard (f64-
+            # persistent, ~3x worse per data doubling).  Fail fast here
+            # with the same policy make_train_step_spatial enforces.
+            raise SystemExit(
+                f"--spatial-shards {spatial_shards} on {num_devices} "
+                f"devices gives a (data={data_size}, space="
+                f"{spatial_shards}) mesh, and bucket(s) "
+                f"{sorted(risky_buckets)} put backbone-stage maps at "
+                "<= 1 row per shard, where deep-backbone spatial "
+                "training with a data axis >= 2 computes measurably "
+                "divergent gradients (see make_train_step_spatial's "
+                "'Data-axis envelope').  Use --num-devices == "
+                "--spatial-shards for the pure-spatial mode, larger "
+                "--image-min/max-side, plain DP, or pass "
+                "--allow-data-axis-divergence to accept the measured "
+                "error"
+            )
         mesh = make_mesh_2d(data_size, spatial_shards)
     else:
         data_size = num_devices
@@ -736,6 +778,7 @@ def main(argv=None) -> dict[str, float]:
         anchor_config=anchor_config,
         shard_weight_update=shard_update,
         quantized_allreduce=quantized,
+        allow_data_axis_divergence=args.allow_data_axis_divergence,
         eval_fn=eval_fn
         if (args.eval_every or args.dataset_type in ("coco", "pascal")
             or (args.dataset_type == "csv" and val_ds is not None))

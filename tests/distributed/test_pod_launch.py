@@ -145,18 +145,18 @@ class _GlooSkewError(AssertionError):
     compiles the most programs)."""
 
 
-def _run_world(worker, work_dir, phase, flavor="plain"):
+def _run_world(worker, work_dir, phase, flavor="plain", nprocs=2):
     env = _world_env(work_dir)  # private per-attempt compilation cache
     coordinator = f"127.0.0.1:{free_port()}"
     procs = [
         subprocess.Popen(
-            [sys.executable, worker, coordinator, "2", str(i), str(work_dir),
-             phase, flavor],
+            [sys.executable, worker, coordinator, str(nprocs), str(i),
+             str(work_dir), phase, flavor],
             env=env,
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
         )
-        for i in range(2)
+        for i in range(nprocs)
     ]
     outs = _communicate_all(procs)
     failing = [out for p, out in zip(procs, outs) if p.returncode]
@@ -174,7 +174,7 @@ def _run_world(worker, work_dir, phase, flavor="plain"):
         assert p.returncode == 0, f"worker ({phase}) failed:\n{out[-3000:]}"
 
 
-def _run_ckpt_eval_phases(tmp_path, flavor):
+def _run_ckpt_eval_phases(tmp_path, flavor, nprocs=2, resume_phase="resume"):
     """Run the train -> kill -> resume sequence; returns the work dir.
 
     Retries ONCE, in a FRESH work dir, if a phase dies on the Gloo
@@ -190,9 +190,15 @@ def _run_ckpt_eval_phases(tmp_path, flavor):
         work_dir.mkdir()
         os.symlink(tmp_path / "data", work_dir / "data")
         try:
-            _run_world(_CKPT_WORKER, work_dir, "train", flavor=flavor)
+            _run_world(
+                _CKPT_WORKER, work_dir, "train", flavor=flavor,
+                nprocs=nprocs,
+            )
             assert (work_dir / "ckpt").exists()
-            _run_world(_CKPT_WORKER, work_dir, "resume", flavor=flavor)
+            _run_world(
+                _CKPT_WORKER, work_dir, resume_phase, flavor=flavor,
+                nprocs=nprocs,
+            )
             return work_dir
         except _GlooSkewError:
             if attempt:
@@ -221,6 +227,35 @@ def test_two_process_checkpoint_resume_and_sharded_eval(tmp_path):
     assert results[0]["metrics"] == results[1]["metrics"]
     # Process 0's in-worker parity assert ran (full_metrics recorded).
     assert "full_metrics" in results[0]
+
+
+@pytest.mark.slow
+def test_four_process_checkpoint_resume(tmp_path):
+    """VERDICT r4 stretch #9: carry the §5.4 checkpoint/resume evidence
+    to the widest world the box supports — 4 hosts x 4 devices.  Orbax
+    save fan-in from FOUR processes (PARITY's stated residual risk),
+    kill, restore into a fresh 4-process world, train on, and every
+    rank's replicated params must be identical.  Eval-free resume phase:
+    the per-rank eval tails would serialize on this box's single core
+    and blow the coordination service's ~30 s shutdown barrier at 4
+    ranks — the sharded-eval parity claim keeps its 2-process
+    coverage in the tests below."""
+    from batchai_retinanet_horovod_coco_tpu.data import make_synthetic_coco
+
+    make_synthetic_coco(
+        str(tmp_path / "data"), num_images=8, num_classes=3,
+        image_size=(64, 64), seed=5, split="val",
+    )
+    work_dir = _run_ckpt_eval_phases(
+        tmp_path, flavor="plain", nprocs=4, resume_phase="resume_noeval"
+    )
+
+    results = []
+    for i in range(4):
+        with open(work_dir / f"eval_{i}.json") as f:
+            results.append(json.load(f))
+    assert all(r["step"] == 5 for r in results)
+    assert len({r["param_sum"] for r in results}) == 1
 
 
 @pytest.mark.slow

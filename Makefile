@@ -19,7 +19,7 @@ DRYFLAG = $(if $(DRY),--dry-run,)
 CLUSTER = python -m batchai_retinanet_horovod_coco_tpu.launch.cluster
 
 .PHONY: create submit status delete test test-timings smoke bench \
-	bench-check bench-pipeline convergence-full
+	bench-check bench-pipeline pipebench pipebench-check convergence-full
 
 create:
 	$(CLUSTER) create --name $(NAME) --zone $(ZONE) --accelerator $(ACCEL) $(DRYFLAG)
@@ -37,7 +37,9 @@ test:
 	python -m pytest tests/ -q
 
 # Regenerate the committed per-test timing snapshot (budget mechanism,
-# tests/conftest.py): run the fast tier warm, write TEST_TIMINGS.md.
+# tests/conftest.py): run the fast tier, write TEST_TIMINGS.md.  Timings
+# include each unique program's once-per-session compile (the cache is
+# per-session; see conftest.py).
 # bash + pipefail: a failing tier must NOT regenerate/bless the snapshot.
 test-timings:
 	bash -o pipefail -c 'python -m pytest tests/ -q -m "not slow" \
@@ -58,8 +60,15 @@ bench:
 bench-check:
 	BENCH_SWEEP=0 BENCH_CHECK=1 python bench.py
 
-bench-pipeline:
+# Host input-pipeline bench: threads-vs-procs sweep (bench_pipeline.py).
+# pipebench-check is the regression tripwire twin of bench-check: measured
+# best vs the committed PIPEBENCH.json value minus the noise band (exit 1).
+bench-pipeline: pipebench
+pipebench:
 	python bench_pipeline.py
+
+pipebench-check:
+	python bench_pipeline.py --check
 
 # Flagship-resolution convergence artifact (VERDICT r2 #2): the REAL recipe
 # — resnet50 frozen_bn, multistep decays at 2/3 and 8/9 of --steps, warmup,

@@ -12,7 +12,12 @@ redesigned for TPU:
 - anchor targets are NOT computed here: the host ships only images + padded
   gt boxes, and target assignment runs on device inside the jit'd step
   (BASELINE.json:5), unlike the reference's CPU loader-thread hot loop
-  (SURVEY.md call stack 3.3).
+  (SURVEY.md call stack 3.3);
+- two interchangeable producers behind one ``build_pipeline`` entrypoint:
+  an in-process thread pool (default; pytest/low-resource) and a
+  multiprocess shared-memory ring buffer (``num_worker_procs > 0``,
+  ``shm_pipeline.py``) that clears the GIL decode ceiling — bit-identical
+  batches for a fixed seed either way.
 """
 
 from batchai_retinanet_horovod_coco_tpu.data.coco import CocoDataset, ImageRecord

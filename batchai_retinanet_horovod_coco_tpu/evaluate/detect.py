@@ -23,7 +23,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from batchai_retinanet_horovod_coco_tpu.parallel.shmap import (
+    shard_map,
+)
 
 from batchai_retinanet_horovod_coco_tpu.data import pipeline as pipeline_lib
 from batchai_retinanet_horovod_coco_tpu.data.coco import CocoDataset

@@ -1,0 +1,34 @@
+"""One import site for ``shard_map`` across jax versions.
+
+jax moved ``shard_map`` from ``jax.experimental.shard_map`` to the top-level
+``jax`` namespace (and renamed its replication-check kwarg ``check_rep`` →
+``check_vma``) across releases.  Importing it from ``jax`` directly made the
+whole SPMD layer (train step, ZeRO, sharded detect) fail to import on the
+older runtime, taking 17 tier-1 test modules down with it.  Every module
+imports the symbol from here instead; callers always write ``check_vma=``
+and the shim translates for the runtime it finds.
+"""
+
+from __future__ import annotations
+
+try:  # newer jax: top-level export, kwarg is check_vma
+    from jax import shard_map as _shard_map
+
+    _CHECK_KW = "check_vma"
+except ImportError:  # older jax: experimental module, kwarg is check_rep
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f=None, *, check_vma=None, **kwargs):
+    """``jax.shard_map`` with the replication-check kwarg normalized.
+
+    Usable exactly like the real thing: directly (``shard_map(fn, mesh=...,
+    ...)``) or via ``functools.partial`` as a decorator.
+    """
+    if check_vma is not None:
+        kwargs[_CHECK_KW] = check_vma
+    if f is None:
+        return lambda fn: _shard_map(fn, **kwargs)
+    return _shard_map(f, **kwargs)

@@ -12,6 +12,8 @@ construction (train/state.py) — and no RedirectModel/convert step.
 from __future__ import annotations
 
 import dataclasses
+import queue
+import threading
 import time
 import warnings
 from typing import Any, Callable, Iterable, Iterator
@@ -78,6 +80,12 @@ class LoopConfig:
     profile_dir: str | None = None
     profile_start_step: int = 10
     profile_steps: int = 5
+    # Device-prefetch depth: a background thread pulls host batches and
+    # enqueues their host→device transfers this many steps ahead, so step k
+    # overlaps both batch k+1's DMA AND the host-side pipeline pull
+    # (assembly, queue handoff).  2 = classic double buffering.  0 disables
+    # the thread (transfer happens synchronously at each step — debugging).
+    device_prefetch: int = 2
 
 
 def _device_batch(batch: Batch, mesh: Mesh | None) -> dict[str, Any]:
@@ -122,22 +130,61 @@ def _prefetch_to_device(
 ) -> Iterator[tuple[tuple[int, ...], dict[str, Any]]]:
     """Yield (images_shape, device_batch), transferring ``depth`` ahead.
 
-    ``device_put`` enqueues the DMA and returns immediately, so keeping a
-    small deque of in-flight batches hides the transfer behind compute.
-    """
-    from collections import deque
+    Double-buffered device prefetch (the standard ``prefetch_to_device``
+    idiom): a background thread pulls host batches and calls
+    ``_device_batch`` — which enqueues the host→device DMA — up to ``depth``
+    batches ahead of the training step.  Versus the old in-line deque, the
+    thread additionally overlaps the HOST side of batch k+1 (pipeline queue
+    wait, batch assembly, the device_put dispatch itself) with step k's
+    compute, so the timed step path only ever blocks when the pipeline is
+    genuinely starved (which ``data_wait_ms`` then reports truthfully).
 
-    buf: deque = deque()
-    it = iter(batches)
+    ``depth <= 0`` degrades to synchronous in-line transfer (debugging).
+    The generator's ``close()`` stops the thread; exceptions from the
+    pipeline (e.g. a crashed decode worker) are re-raised here.
+    """
+    if depth <= 0:
+        for batch in batches:
+            yield (batch.images.shape, _device_batch(batch, mesh))
+        return
+
+    from batchai_retinanet_horovod_coco_tpu.data.pipeline import (
+        stop_gated_put,
+    )
+
+    buf: queue.Queue = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+    end = object()  # stream exhausted sentinel
+
+    def _enqueue(item) -> bool:
+        return stop_gated_put(buf, item, stop)
+
+    def feeder() -> None:
+        try:
+            for batch in batches:
+                item = (batch.images.shape, _device_batch(batch, mesh))
+                if not _enqueue(item):
+                    return
+                if stop.is_set():
+                    return
+            _enqueue(end)
+        except BaseException as exc:  # propagate to the step loop
+            _enqueue(exc)
+
+    thread = threading.Thread(
+        target=feeder, daemon=True, name="device-prefetch"
+    )
+    thread.start()
     try:
         while True:
-            while len(buf) < depth:
-                batch = next(it)
-                buf.append((batch.images.shape, _device_batch(batch, mesh)))
-            yield buf.popleft()
-    except StopIteration:
-        while buf:
-            yield buf.popleft()
+            item = buf.get()
+            if item is end:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
 
 
 def _compile_barrier(step_fn, state, device_arrays, hw) -> None:
@@ -311,122 +358,128 @@ def run_training(
     window_data_wait = 0.0  # host time blocked on the input pipeline
     window_steps = 0
     metrics = None
-    it = _prefetch_to_device(batches, mesh)
+    it = _prefetch_to_device(batches, mesh, config.device_prefetch)
 
-    for step in range(start_step + 1, config.total_steps + 1):
-        t_data = time.perf_counter()
-        images_shape, device_arrays = next(it)
-        window_data_wait += time.perf_counter() - t_data
-        window_steps += 1
-        hw = images_shape[1:3]
-        step_fn = step_fns.get(hw)
-        if step_fn is None:
-            if spatial:
-                step_fn = step_fns[hw] = make_train_step_spatial(
-                    model,
-                    hw,
-                    num_classes,
-                    mesh=mesh,
-                    loss_config=loss_config,
-                    matching_config=matching_config,
-                    anchor_config=anchor_config,
-                    allow_data_axis_divergence=allow_data_axis_divergence,
-                )
-            else:
-                step_fn = step_fns[hw] = make_train_step(
-                    model,
-                    hw,
-                    num_classes,
-                    mesh=mesh,
-                    loss_config=loss_config,
-                    matching_config=matching_config,
-                    anchor_config=anchor_config,
-                    shard_weight_update=shard_weight_update,
-                    quantized_allreduce=quantized_allreduce,
-                )
-            # No process may enter the step's collectives while a peer is
-            # still compiling (collective timeouts << compile times).
-            _compile_barrier(step_fn, state, device_arrays, hw)
-        if config.profile_dir and step == prof_start:
-            jax.profiler.start_trace(config.profile_dir)
-        state, metrics = step_fn(state, device_arrays)
-        if config.profile_dir and step == prof_end:
-            jax.block_until_ready(metrics)
-            jax.profiler.stop_trace()
-        # Global batch size = local batch × process_count (each process
-        # feeds its shard of the global batch).
-        window_images += images_shape[0] * (
-            jax.process_count() if mesh is not None else 1
-        )
-
-        # ``step`` is tracked host-side (state.step mirrors it) so the loop
-        # never forces a per-step device sync on tunneled TPU backends; the
-        # finiteness sanitizer therefore runs at a bounded cadence — every
-        # log window, every _FINITE_CHECK_EVERY steps when log_every=0, and
-        # unconditionally before any checkpoint save (a NaN-poisoned state
-        # must never reach disk: auto-resume would restore the poison and
-        # make recovery impossible without --no-resume).
-        is_log = (
-            config.log_every and step % config.log_every == 0
-        ) or step == config.total_steps
-        will_save = ckpt is not None and ckpt.should_save(step)
-        check_every = config.log_every or _FINITE_CHECK_EVERY
-        cadence = (
-            f"every {check_every} steps and before each checkpoint save"
-        )
-        if not is_log and (will_save or step % check_every == 0):
-            for name in _SENTINEL_METRICS:
-                if name in metrics:
-                    _assert_finite(
-                        jax.device_get(metrics[name]), name, step, cadence
+    try:
+        for step in range(start_step + 1, config.total_steps + 1):
+            t_data = time.perf_counter()
+            images_shape, device_arrays = next(it)
+            window_data_wait += time.perf_counter() - t_data
+            window_steps += 1
+            hw = images_shape[1:3]
+            step_fn = step_fns.get(hw)
+            if step_fn is None:
+                if spatial:
+                    step_fn = step_fns[hw] = make_train_step_spatial(
+                        model,
+                        hw,
+                        num_classes,
+                        mesh=mesh,
+                        loss_config=loss_config,
+                        matching_config=matching_config,
+                        anchor_config=anchor_config,
+                        allow_data_axis_divergence=allow_data_axis_divergence,
                     )
-
-        if is_log:
-            scalars = {k: v for k, v in jax.device_get(metrics).items()}
-            for name in _SENTINEL_METRICS:
-                if name in scalars:
-                    _assert_finite(scalars[name], name, step, cadence)
-            dt = time.perf_counter() - window_t0
-            scalars["images_per_sec"] = window_images / max(dt, 1e-9)
-            # Step-time breakdown (SURVEY.md §5.5): how much of the step the
-            # host spent BLOCKED on the input pipeline — the classic
-            # detection scaling-efficiency killer (SURVEY.md §7.3 part 6).
-            scalars["step_time_ms"] = dt / max(window_steps, 1) * 1e3
-            scalars["data_wait_ms"] = (
-                window_data_wait / max(window_steps, 1) * 1e3
+                else:
+                    step_fn = step_fns[hw] = make_train_step(
+                        model,
+                        hw,
+                        num_classes,
+                        mesh=mesh,
+                        loss_config=loss_config,
+                        matching_config=matching_config,
+                        anchor_config=anchor_config,
+                        shard_weight_update=shard_weight_update,
+                        quantized_allreduce=quantized_allreduce,
+                    )
+                # No process may enter the step's collectives while a peer is
+                # still compiling (collective timeouts << compile times).
+                _compile_barrier(step_fn, state, device_arrays, hw)
+            if config.profile_dir and step == prof_start:
+                jax.profiler.start_trace(config.profile_dir)
+            state, metrics = step_fn(state, device_arrays)
+            if config.profile_dir and step == prof_end:
+                jax.block_until_ready(metrics)
+                jax.profiler.stop_trace()
+            # Global batch size = local batch × process_count (each process
+            # feeds its shard of the global batch).
+            window_images += images_shape[0] * (
+                jax.process_count() if mesh is not None else 1
             )
-            # Cumulative gt boxes dropped by max_gt padding (pipeline
-            # counter) — silent truncation poisons targets, so it is a
-            # first-class metric whenever it is nonzero.
-            pipe_stats = getattr(batches, "stats", None)
-            if pipe_stats is not None and pipe_stats.truncated_boxes:
-                scalars["truncated_gt_boxes"] = pipe_stats.truncated_boxes
-            if schedule is not None:
-                scalars["lr"] = float(schedule(step - 1))
-                scale = optim.plateau_scale(state.opt_state)
-                if scale is not None:
-                    scalars["lr"] *= scale  # data-driven ReduceLROnPlateau
-            logger.log(step, scalars)
-            window_t0 = time.perf_counter()
-            window_images = 0
-            window_data_wait = 0.0
-            window_steps = 0
 
-        if will_save and ckpt.save(state, step=step):
-            last_saved = step
+            # ``step`` is tracked host-side (state.step mirrors it) so the loop
+            # never forces a per-step device sync on tunneled TPU backends; the
+            # finiteness sanitizer therefore runs at a bounded cadence — every
+            # log window, every _FINITE_CHECK_EVERY steps when log_every=0, and
+            # unconditionally before any checkpoint save (a NaN-poisoned state
+            # must never reach disk: auto-resume would restore the poison and
+            # make recovery impossible without --no-resume).
+            is_log = (
+                config.log_every and step % config.log_every == 0
+            ) or step == config.total_steps
+            will_save = ckpt is not None and ckpt.should_save(step)
+            check_every = config.log_every or _FINITE_CHECK_EVERY
+            cadence = (
+                f"every {check_every} steps and before each checkpoint save"
+            )
+            if not is_log and (will_save or step % check_every == 0):
+                for name in _SENTINEL_METRICS:
+                    if name in metrics:
+                        _assert_finite(
+                            jax.device_get(metrics[name]), name, step, cadence
+                        )
 
-        if (
-            eval_fn is not None
-            and config.eval_every
-            and step % config.eval_every == 0
-            and step < config.total_steps
-        ):
-            logger.log(step, eval_fn(state), prefix="eval")
-            # Eval time must not pollute the next window's step-time metrics.
-            window_t0 = time.perf_counter()
-            window_images = 0
-            window_data_wait = 0.0
-            window_steps = 0
+            if is_log:
+                scalars = {k: v for k, v in jax.device_get(metrics).items()}
+                for name in _SENTINEL_METRICS:
+                    if name in scalars:
+                        _assert_finite(scalars[name], name, step, cadence)
+                dt = time.perf_counter() - window_t0
+                scalars["images_per_sec"] = window_images / max(dt, 1e-9)
+                # Step-time breakdown (SURVEY.md §5.5): how much of the step the
+                # host spent BLOCKED on the input pipeline — the classic
+                # detection scaling-efficiency killer (SURVEY.md §7.3 part 6).
+                scalars["step_time_ms"] = dt / max(window_steps, 1) * 1e3
+                scalars["data_wait_ms"] = (
+                    window_data_wait / max(window_steps, 1) * 1e3
+                )
+                # Cumulative gt boxes dropped by max_gt padding (pipeline
+                # counter) — silent truncation poisons targets, so it is a
+                # first-class metric whenever it is nonzero.
+                pipe_stats = getattr(batches, "stats", None)
+                if pipe_stats is not None and pipe_stats.truncated_boxes:
+                    scalars["truncated_gt_boxes"] = pipe_stats.truncated_boxes
+                if schedule is not None:
+                    scalars["lr"] = float(schedule(step - 1))
+                    scale = optim.plateau_scale(state.opt_state)
+                    if scale is not None:
+                        scalars["lr"] *= scale  # data-driven ReduceLROnPlateau
+                logger.log(step, scalars)
+                window_t0 = time.perf_counter()
+                window_images = 0
+                window_data_wait = 0.0
+                window_steps = 0
+
+            if will_save and ckpt.save(state, step=step):
+                last_saved = step
+
+            if (
+                eval_fn is not None
+                and config.eval_every
+                and step % config.eval_every == 0
+                and step < config.total_steps
+            ):
+                logger.log(step, eval_fn(state), prefix="eval")
+                # Eval time must not pollute the next window's step-time metrics.
+                window_t0 = time.perf_counter()
+                window_images = 0
+                window_data_wait = 0.0
+                window_steps = 0
+
+    finally:
+        # Stop the prefetch thread deterministically (even when the
+        # loop exits via an exception) before eval/checkpoint epilogue.
+        it.close()
 
     final_step = max(start_step, config.total_steps)
     if eval_fn is not None:

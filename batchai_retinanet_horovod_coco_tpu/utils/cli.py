@@ -50,6 +50,45 @@ def add_anchor_flags(parser) -> None:
                         help="octave scales (default 1,2^(1/3),2^(2/3))")
 
 
+def add_data_pipeline_flags(parser) -> None:
+    """The host input-pipeline flag surface (train.py; one definition so
+    tools that grow a pipeline later can't drift).
+
+    Sizing guidance lives in RUNBOOK.md ("Feeding the chips"): threads
+    plateau at ~2 effective workers (PIL JPEG decode holds the GIL), so on
+    hosts that must exceed ~35 imgs/s the process pool is the lever.
+    """
+    parser.add_argument("--workers", "--data-workers", dest="workers",
+                        type=int, default=16,
+                        help="decode THREADS for the in-process pool "
+                             "(ignored when --data-worker-procs > 0 "
+                             "selects the multiprocess producer)")
+    parser.add_argument("--data-worker-procs", type=int, default=0,
+                        help="decode worker PROCESSES writing into shared-"
+                             "memory ring buffers (data/shm_pipeline.py); "
+                             "0 = in-process thread pool.  Use ~1 process "
+                             "per 35 imgs/s of step demand; bit-identical "
+                             "batches either way")
+    parser.add_argument("--data-worker-timeout", type=float, default=120.0,
+                        help="seconds a head-of-line batch may stall before "
+                             "the multiprocess pipeline raises (crash "
+                             "detection is immediate; this bounds WEDGED "
+                             "workers)")
+    parser.add_argument("--device-prefetch", type=int, default=2,
+                        help="batches transferred host->device ahead of the "
+                             "step by a background thread (double "
+                             "buffering); 0 = synchronous transfer")
+
+
+def make_pipeline_worker_kwargs(args) -> dict:
+    """PipelineConfig kwargs for the worker/prefetch flags above."""
+    return dict(
+        num_workers=args.workers,
+        num_worker_procs=getattr(args, "data_worker_procs", 0) or 0,
+        worker_timeout=getattr(args, "data_worker_timeout", 120.0),
+    )
+
+
 def make_anchor_config(args) -> AnchorConfig:
     """AnchorConfig from the CLI flags (defaults where flags are unset).
 

@@ -5,17 +5,32 @@ workloads (SURVEY.md §7.3 part 6): at pod scale every host must decode
 enough images per second to feed its chips (~4 chips/host on v5e, so
 4 x chip-throughput imgs/s/host).  This bench measures the REAL pipeline —
 JPEG decode, multiscale resize to the flagship buckets, pad/assemble,
-target-free (targets are computed on device) — against worker count, and
-prints one JSON line:
+target-free (targets are computed on device) — for BOTH producers:
 
-  {"metric": "host_pipeline_images_per_sec", "value": <best>,
-   "per_worker": {"1": ..., "2": ..., ...}, "cores_available": N, ...}
+- ``threads``: the in-process ThreadPoolExecutor path, swept over thread
+  counts.  Round 5 showed it plateaus at ~2 workers (PIL JPEG decode holds
+  the GIL) at ~37 imgs/s/host — below one chip's ~67 imgs/s demand.
+- ``procs``: the multiprocess shared-memory path (data/shm_pipeline.py),
+  swept over process counts — the GIL-free producer this plateau motivated.
+
+It prints one JSON line:
+
+  {"metric": "host_pipeline_images_per_sec", "value": <best overall>,
+   "threads": {"1": ..., ...}, "procs": {"1": ..., ...},
+   "best_threads": ..., "best_procs": ..., "procs_speedup": ...,
+   "cores_available": N, ...}
 
 Run it on the actual pod host class to validate the scaling argument in
 PARITY.md; the committed PIPEBENCH.json records this dev box's numbers
 (note its core count — per-core throughput is the portable figure).
 
-Usage: python bench_pipeline.py [--images N] [--batches N] [--workers 1,2,4,8]
+``--check`` mirrors bench.py's bench-check tripwire: the measured best must
+stay within NOISE_BAND_PCT of the committed PIPEBENCH.json value (exit 1 on
+regression).  A crashed decode worker surfaces as the sweep point's
+``error`` string rather than killing (or hanging) the whole bench.
+
+Usage: python bench_pipeline.py [--images N] [--batches N]
+         [--workers 1,2,4,8] [--procs 1,2,4] [--check]
 """
 
 from __future__ import annotations
@@ -23,11 +38,27 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import sys
 import tempfile
 import time
 
+# Same tripwire policy as bench.py's bench-check, with a much wider band:
+# the host pipeline is scheduler-noisy in a way the device bench is not
+# (process spawn, page-cache state, sibling load on shared/sandboxed dev
+# boxes — full-run best-of-2 values were observed ranging ~90-106 imgs/s
+# on the committed box).  The tripwire exists to catch structural
+# regressions (a serialized producer, a quadratic assembly), which cost
+# 2x+, not to police single-digit drift.
+NOISE_BAND_PCT = 15.0
 
-def run_one(data_dir: str, num_workers: int, batches: int, batch_size: int) -> float:
+
+def run_one(
+    data_dir: str,
+    num_workers: int,
+    batches: int,
+    batch_size: int,
+    num_worker_procs: int = 0,
+) -> float:
     from batchai_retinanet_horovod_coco_tpu.data import (
         CocoDataset,
         PipelineConfig,
@@ -48,20 +79,155 @@ def run_one(data_dir: str, num_workers: int, batches: int, batch_size: int) -> f
             max_side=1344,
             max_gt=100,
             num_workers=num_workers,
+            num_worker_procs=num_worker_procs,
             seed=0,
         ),
         train=True,
     )
-    it = iter(pipe)
-    next(it)  # warmup: thread pool spin-up + first-batch latency
-    t0 = time.perf_counter()
-    n = 0
-    for _ in range(batches):
-        batch = next(it)
-        n += batch.images.shape[0]
-    dt = time.perf_counter() - t0
-    pipe.close()
+    try:
+        it = iter(pipe)
+        next(it)  # warmup: worker pool spin-up + first-batch latency
+        t0 = time.perf_counter()
+        n = 0
+        for _ in range(batches):
+            batch = next(it)
+            n += batch.images.shape[0]
+        dt = time.perf_counter() - t0
+    finally:
+        pipe.close()
     return n / dt
+
+
+def sweep(
+    data_dir: str, counts: list[int], batches: int, batch_size: int,
+    procs: bool, repeats: int = 2,
+) -> dict[str, float | str]:
+    """One producer's sweep; a crashed/wedged worker becomes that point's
+    ``error`` string instead of aborting the other points.
+
+    Each point takes the BEST of ``repeats`` runs: on shared/sandboxed dev
+    boxes a single run can lose 2x to transient sibling load, and the
+    quantity of interest is the producer's capacity, not the box's weather.
+    """
+    out: dict[str, float | str] = {}
+    for c in counts:
+        rates = []
+        err = None
+        for _ in range(max(1, repeats)):
+            try:
+                rates.append(run_one(
+                    data_dir, 0 if procs else c, batches, batch_size,
+                    num_worker_procs=c if procs else 0,
+                ))
+            except RuntimeError as e:
+                err = e
+        out[str(c)] = round(max(rates), 2) if rates else f"error: {err}"
+    return out
+
+
+def _ceiling_worker(data_dir: str, q) -> None:
+    """One fully independent decode loop — no queues, no shared memory, no
+    coordination.  N of these concurrently measure the HARDWARE's parallel
+    decode capacity, the number the coordinated procs path is fairly judged
+    against (vCPUs on shared/sandboxed dev boxes often deliver far less
+    than cores_available x single-core throughput for this memory-bound
+    workload)."""
+    try:
+        import cv2
+
+        cv2.setNumThreads(1)
+    except Exception:
+        pass
+    import time as _time
+
+    from batchai_retinanet_horovod_coco_tpu.data import (
+        CocoDataset,
+        PipelineConfig,
+    )
+    from batchai_retinanet_horovod_coco_tpu.data.pipeline import (
+        bucket_for_source,
+        default_buckets,
+        example_rng,
+        load_example,
+    )
+
+    ds = CocoDataset(
+        os.path.join(data_dir, "instances_train.json"),
+        os.path.join(data_dir, "train"),
+    )
+    cfg = PipelineConfig(
+        batch_size=8, buckets=default_buckets(800, 1344), min_side=800,
+        max_side=1344, max_gt=100, seed=0,
+    )
+
+    def one_pass():
+        for i, r in enumerate(ds.records):
+            b = bucket_for_source(r.height, r.width, 800, 1344, cfg.buckets)
+            load_example(ds, r, cfg, example_rng(cfg, True, 0, i), b)
+
+    one_pass()  # warm (page cache, imports)
+    t0 = _time.perf_counter()
+    one_pass()
+    q.put(len(ds.records) / (_time.perf_counter() - t0))
+
+
+def measure_ceiling(data_dir: str, nprocs: int) -> float:
+    """Aggregate imgs/s of ``nprocs`` INDEPENDENT decode processes."""
+    import multiprocessing as mp
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(target=_ceiling_worker, args=(data_dir, q))
+        for _ in range(nprocs)
+    ]
+    for p in procs:
+        p.start()
+    # Bounded get + liveness: a crashed worker (OOM, bad data dir) must
+    # degrade the measurement, never hang the bench.
+    import queue as _queue
+
+    total = 0.0
+    received = 0
+    deadline = time.monotonic() + 300.0
+    while received < len(procs) and time.monotonic() < deadline:
+        try:
+            total += q.get(timeout=5.0)
+            received += 1
+        except _queue.Empty:
+            if all(p.exitcode is not None for p in procs) and q.empty():
+                break  # some worker died without reporting
+    if received < len(procs):
+        print(
+            f"pipebench: {len(procs) - received} ceiling worker(s) died "
+            "without reporting; ceiling reflects the survivors",
+            file=sys.stderr,
+        )
+    for p in procs:
+        p.join(timeout=10.0)
+        if p.is_alive():
+            p.terminate()
+    return total
+
+
+def _committed_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "PIPEBENCH.json")
+
+
+def check_against_committed(value: float) -> int:
+    """bench.py's bench-check, for the host pipeline: the committed
+    PIPEBENCH.json best minus the noise band is the floor; exit 1 below it."""
+    with open(_committed_path()) as f:
+        committed = float(json.load(f)["value"])
+    floor = committed * (1 - NOISE_BAND_PCT / 100)
+    ok = value >= floor
+    verdict = "ok" if ok else "REGRESSION"
+    print(
+        f"pipebench-check: measured {value:.2f} vs committed {committed:.2f} "
+        f"(floor {floor:.2f} = -{NOISE_BAND_PCT}%): {verdict}"
+    )
+    return 0 if ok else 1
 
 
 def main() -> None:
@@ -70,7 +236,16 @@ def main() -> None:
                     help="synthetic JPEG count (COCO-typical 640x480)")
     ap.add_argument("--batches", type=int, default=6)
     ap.add_argument("--batch-size", type=int, default=8)
-    ap.add_argument("--workers", default="1,2,4,8")
+    ap.add_argument("--workers", default="1,2,4,8",
+                    help="thread-pool sweep (comma list; empty to skip)")
+    ap.add_argument("--procs", default="1,2,4",
+                    help="multiprocess shm-pipeline sweep (comma list; "
+                         "empty to skip)")
+    ap.add_argument("--repeats", type=int, default=2,
+                    help="runs per sweep point; the best is reported")
+    ap.add_argument("--check", action="store_true",
+                    help="compare the measured best against the committed "
+                         "PIPEBENCH.json noise band; exit 1 on regression")
     ap.add_argument("--data-dir", default=None,
                     help="existing COCO-format dir (default: synthesize)")
     args = ap.parse_args()
@@ -88,26 +263,57 @@ def main() -> None:
             image_size=(480, 640), seed=0, split="train",
         )
 
-    per_worker: dict[str, float] = {}
-    for w in [int(x) for x in args.workers.split(",")]:
-        per_worker[str(w)] = round(run_one(
-            data_dir, w, args.batches, args.batch_size
-        ), 2)
+    def parse_counts(text: str) -> list[int]:
+        return [int(x) for x in text.split(",") if x.strip()]
 
-    best = max(per_worker.values())
+    threads = sweep(data_dir, parse_counts(args.workers), args.batches,
+                    args.batch_size, procs=False, repeats=args.repeats)
+    procs = sweep(data_dir, parse_counts(args.procs), args.batches,
+                  args.batch_size, procs=True, repeats=args.repeats)
+
+    def best(d: dict) -> float:
+        vals = [v for v in d.values() if isinstance(v, (int, float))]
+        return max(vals) if vals else 0.0
+
+    best_threads, best_procs = best(threads), best(procs)
+    value = max(best_threads, best_procs)
     cores = len(os.sched_getaffinity(0))
+    proc_counts = parse_counts(args.procs)
+    ceiling = (
+        round(measure_ceiling(data_dir, max(proc_counts)), 2)
+        if proc_counts else None
+    )
     print(json.dumps({
         "metric": "host_pipeline_images_per_sec",
-        "value": best,
+        "value": value,
         "unit": "images/sec/host",
-        "per_worker": per_worker,
+        "threads": threads,
+        "procs": procs,
+        "best_threads": best_threads,
+        "best_procs": best_procs,
+        # The headline ratio: how much the GIL-free producer buys on THIS
+        # box (compare like-for-like in one run; absolute rates depend on
+        # core count and sibling load).
+        "procs_speedup": round(best_procs / best_threads, 2)
+        if best_threads and best_procs else None,
+        # What the hardware gives N INDEPENDENT decode processes (no
+        # coordination): the fair denominator for the procs path.  Shared/
+        # sandboxed dev boxes can deliver far below cores x single-proc for
+        # this memory-bound workload, in which case NO producer design can
+        # beat threads by much — judge the procs path by its efficiency
+        # against this ceiling, and the threads-vs-procs gap by core count.
+        "independent_decode_ceiling": ceiling,
+        "procs_efficiency_vs_ceiling": round(best_procs / ceiling, 2)
+        if ceiling else None,
         "cores_available": cores,
-        "per_core": round(best / max(cores, 1), 2),
+        "per_core": round(value / max(cores, 1), 2),
         "source_resolution": "640x480 JPEG",
         "target": "800x1344-bucketed multiscale resize + pad",
     }))
     if tmp is not None:
         tmp.cleanup()
+    if args.check:
+        raise SystemExit(check_against_committed(value))
 
 
 if __name__ == "__main__":

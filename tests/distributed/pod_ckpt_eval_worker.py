@@ -144,11 +144,36 @@ def main(coordinator, num_processes, process_id, work_dir, phase, flavor="plain"
     # across ranks on a single-core box, spreading them past Gloo's
     # ~30 s collective timeout before orbax's first sync_global_processes
     # at 4 ranks) — same mechanism as the loop's compile barrier.
-    from jax._src import distributed as _dist
+    # Preferred: the coordination-service barrier (gRPC, 10 min budget —
+    # the whole POINT is that ranks may be minutes apart, which a device
+    # collective's ~30 s timeout cannot absorb).  Its client lives in the
+    # private jax._src.distributed module, so a jax upgrade may move it;
+    # when that happens, fall back to the public sync_global_devices with
+    # a LOUD warning (it still aligns ranks, but only within the Gloo
+    # timeout — a silent no-barrier would make this test flake instead).
+    _barrier_name = f"worker_init_{phase}"
+    try:
+        from jax._src import distributed as _dist
 
-    _client = getattr(getattr(_dist, "global_state", None), "client", None)
+        _client = getattr(
+            getattr(_dist, "global_state", None), "client", None
+        )
+    except ImportError:
+        _client = None
     if _client is not None:
-        _client.wait_at_barrier(f"worker_init_{phase}", 600_000)
+        _client.wait_at_barrier(_barrier_name, 600_000)
+    else:
+        import warnings
+
+        warnings.warn(
+            "jax._src.distributed client unavailable (jax moved the "
+            "private module?): falling back to sync_global_devices for "
+            f"the {_barrier_name} barrier — ranks more than ~30s apart "
+            "will now hit the Gloo collective timeout"
+        )
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(_barrier_name)
 
     if phase == "train":
         state = run_training(

@@ -15,7 +15,10 @@ import optax
 import pytest
 from jax import lax
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+
+from batchai_retinanet_horovod_coco_tpu.parallel.shmap import (
+    shard_map,
+)
 
 from batchai_retinanet_horovod_coco_tpu.models import RetinaNetConfig, build_retinanet
 from batchai_retinanet_horovod_coco_tpu.parallel import make_mesh

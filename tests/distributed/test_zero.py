@@ -56,11 +56,16 @@ def run_steps(step_fn, state, batches):
 @pytest.mark.parametrize(
     "opt_config",
     [
-        OptimizerConfig(optimizer="sgd", warmup_steps=2, total_steps=10),
-        # Each flavor costs a 23-31 s compile on the CPU mesh (round-4
-        # timing report); the fast tier keeps the plain-sgd baseline and
-        # the hardest composition (freeze + ACTIVE clip, which has caught
-        # real masking bugs) — the middle permutations run in slow.
+        # Each flavor costs a ~60 s per-session compile on the CPU mesh
+        # (post-cache-loss recalibration; the machine-persistent compile
+        # cache is gone — see tests/conftest.py); the fast tier keeps ONE
+        # leg, the hardest composition (freeze + ACTIVE clip, which has
+        # caught real masking bugs and subsumes the plain baseline's
+        # sharded==replicated claim) — the rest run in slow.
+        pytest.param(
+            OptimizerConfig(optimizer="sgd", warmup_steps=2, total_steps=10),
+            marks=pytest.mark.slow,
+        ),
         pytest.param(
             OptimizerConfig(optimizer="adam", warmup_steps=0, total_steps=10),
             marks=pytest.mark.slow,

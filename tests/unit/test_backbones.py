@@ -65,10 +65,12 @@ def test_feature_strides_and_channels(factory, c_channels):
 @pytest.mark.parametrize(
     "backbone",
     [
-        "mobilenet",
+        # One family proves assembly+grad in the fast tier; mobilenet's
+        # ~43 s and densenet's ~40 s per-session compiles ride in slow
+        # (post-cache-loss recalibration — mobilenet's shape contract
+        # stays fast via test_feature_strides_and_channels[mobilenet]).
+        pytest.param("mobilenet", marks=pytest.mark.slow),
         "vgg16",
-        # 40 s of densenet compile for assembly+grad already proven by the
-        # two lighter families — slow tier (round-4 timing report).
         pytest.param("densenet121", marks=pytest.mark.slow),
     ],
 )

@@ -13,11 +13,14 @@ always fits — the phantom bucket cost a dead multi-minute compile per
 run and a 4% phantom share.
 """
 
+import os
 import sys
 
 import pytest
 
-sys.path.insert(0, "/root/repo")
+# repo root, derived from this file's own path (the suite must run
+# from any checkout location, not just /root/repo)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
 
 from batchai_retinanet_horovod_coco_tpu.data.pipeline import (  # noqa: E402
     bucket_for_source,

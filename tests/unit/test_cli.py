@@ -5,11 +5,14 @@ The reference's only "test" was that the job ran and loss went down
 surface — pipeline, SPMD loop, eval — on the 8-device CPU mesh.
 """
 
+import os
 import sys
 
 import pytest
 
-sys.path.insert(0, "/root/repo")  # repo root (train.py lives there)
+# repo root (train.py lives there), derived from this file's location
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, _REPO_ROOT)
 
 from train import PRESETS, default_buckets, parse_args  # noqa: E402
 

@@ -59,9 +59,12 @@ def test_unknown_shape_rejected(tiny_model_and_state, tmp_path):
 @pytest.mark.slow
 def test_convert_model_cli(tiny_model_and_state, tmp_path, monkeypatch):
     """End-to-end: train 1 step with snapshots, convert, reload, run."""
+    import os
     import sys
 
-    sys.path.insert(0, "/root/repo")
+    # repo root, derived from this file's own path
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
     import convert_model
     from train import main as train_main
 

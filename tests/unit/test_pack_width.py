@@ -76,12 +76,19 @@ def test_packed_forward_matches_plain(norm_kind):
                 bs_p,
                 bs_q,
             )
+        # Train-mode bn gets an order of magnitude more absolute slack:
+        # its batch statistics are live reductions whose order differs
+        # between the packed (W/2, 2C) and plain layouts, and the variance
+        # rsqrt amplifies that reordering — measured 6.9e-4 max-abs on 4 of
+        # 8192 elements with XLA 0.4.37's scheduling (gn / frozen_bn, whose
+        # normalizers carry no batch reduction, stay at the tight bound).
+        tol = 1e-3 if (norm_kind == "bn" and train) else 1e-4
         for key in ("c3", "c4", "c5"):
             np.testing.assert_allclose(
                 np.asarray(out_q[key]),
                 np.asarray(out_p[key]),
                 rtol=1e-4,
-                atol=1e-4,
+                atol=tol,
                 err_msg=f"{norm_kind} train={train} {key}",
             )
 

@@ -67,7 +67,9 @@ from batchai_retinanet_horovod_coco_tpu.models.retinanet import (  # noqa: E402
 # Shared with convert_model.py / debug.py — one anchor surface (utils/cli.py).
 from batchai_retinanet_horovod_coco_tpu.utils.cli import (  # noqa: E402
     add_anchor_flags,
+    add_data_pipeline_flags,
     make_anchor_config,
+    make_pipeline_worker_kwargs,
     resolve_anchor_config,
     save_anchor_config,
 )
@@ -169,9 +171,11 @@ def build_parser() -> argparse.ArgumentParser:
                        help="gt boxes padded per image; default auto-sizes "
                             "to the dataset's true per-image max (COCO "
                             "images can exceed 100) so no box is dropped")
-        g.add_argument("--workers", type=int, default=16,
-                       help="decode threads; TPU-VM hosts have ~112 vCPUs "
-                            "and need ~1 core per 3 imgs/s of step demand")
+        # --workers / --data-workers / --data-worker-procs /
+        # --data-worker-timeout / --device-prefetch (utils/cli.py — shared
+        # surface; TPU-VM hosts have ~112 vCPUs and need ~1 core per
+        # 3 imgs/s of step demand).
+        add_data_pipeline_flags(g)
         g.add_argument("--random-transform", action="store_true",
                        help="full random affine + photometric augmentation "
                             "(reference --random-transform; default is "
@@ -631,7 +635,10 @@ def main(argv=None) -> dict[str, float]:
         max_side=args.image_max_side,
         max_gt=args.max_gt,
         seed=args.seed,
-        num_workers=args.workers,
+        # --workers / --data-worker-procs / --data-worker-timeout: the
+        # multiprocess shared-memory producer when procs > 0 (RUNBOOK.md
+        # "Feeding the chips"), the thread pool otherwise.
+        **make_pipeline_worker_kwargs(args),
     )
     train_transform = None
     if getattr(args, "random_transform", False):
@@ -772,6 +779,7 @@ def main(argv=None) -> dict[str, float]:
             checkpoint_dir=args.snapshot_path,
             resume=not args.no_resume,
             profile_dir=args.profile_dir,
+            device_prefetch=args.device_prefetch,
         ),
         mesh=mesh,
         schedule=schedule,

@@ -19,7 +19,8 @@ DRYFLAG = $(if $(DRY),--dry-run,)
 CLUSTER = python -m batchai_retinanet_horovod_coco_tpu.launch.cluster
 
 .PHONY: create submit status delete test test-timings smoke bench \
-	bench-check bench-pipeline pipebench pipebench-check convergence-full
+	bench-check bench-pipeline pipebench pipebench-check evalbench \
+	evalbench-check canaries convergence-full
 
 create:
 	$(CLUSTER) create --name $(NAME) --zone $(ZONE) --accelerator $(ACCEL) $(DRYFLAG)
@@ -55,10 +56,33 @@ smoke:
 bench:
 	python bench.py
 
-# Regression tripwire: flagship-bucket bench vs the committed
-# BUCKETBENCH.json number minus the 3% noise band (exit 1 on regression).
+# Regression tripwire: flagship-bucket TRAIN bench vs the committed
+# BUCKETBENCH.json number, THEN the eval/detect fast path vs the committed
+# EVALBENCH.json number — both with the 3% noise band (exit 1 on either
+# regression).  Both modes probe the TPU first and classify a tunnel
+# outage as ONE structured JSON line + exit 75, never an rc-1 traceback.
 bench-check:
 	BENCH_SWEEP=0 BENCH_CHECK=1 python bench.py
+	BENCH_SWEEP=0 EVALBENCH_E2E=0 BENCH_CHECK=1 python bench.py --mode eval
+
+# Eval/detect fast-path bench (ISSUE 2): per-bucket AOT detect + NMS-only
+# ms/batch + sequential-vs-pipelined end-to-end comparison, one JSON line.
+# evalbench-check is its regression tripwire (same policy as bench-check;
+# a device-kind mismatch vs the committed artifact passes with a loud
+# note to re-capture).
+evalbench:
+	python bench.py --mode eval
+
+evalbench-check:
+	BENCH_SWEEP=0 EVALBENCH_E2E=0 BENCH_CHECK=1 python bench.py --mode eval
+
+# All four XLA-partitioner canaries in one shot (VERDICT r5 next-round #5):
+# each asserts its bug's PRESENCE on the current jax/XLA (or skips when the
+# installed version doesn't exhibit it) — a flip after a jax upgrade is the
+# signal to re-measure the guards.  Filing-ready upstream text per repro:
+# scripts/xla_repros/ISSUES.md.
+canaries:
+	python -m pytest tests/distributed/test_spatial_train.py -q -k canary
 
 # Host input-pipeline bench: threads-vs-procs sweep (bench_pipeline.py).
 # pipebench-check is the regression tripwire twin of bench-check: measured

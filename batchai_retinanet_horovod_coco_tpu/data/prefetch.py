@@ -1,0 +1,82 @@
+"""Background-thread prefetch: the ONE overlap idiom train and eval share.
+
+PR 1 built double-buffered device prefetch for the train loop
+(``train/loop.py``): a background thread pulls host batches and enqueues
+their host→device DMA a bounded number of steps ahead, so step k's compute
+overlaps batch k+1's transfer AND the host side of producing it (pipeline
+queue wait, batch assembly, the ``device_put`` dispatch itself).  The eval
+fast path (ISSUE 2) needs exactly the same machinery with a different
+per-item transfer, so the thread/queue/stop/error skeleton lives here once
+— ``prefetch_map`` — and both loops supply only their transfer function.
+
+Error contract (same as the shm pipeline's, data/shm_pipeline.py): an
+exception in the producer thread — including one raised by the underlying
+batch iterable, e.g. a crashed decode worker — is re-raised in the
+consumer; ``close()`` (generator close) stops the thread promptly even
+when the bounded queue is full (every put is stop-gated).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterable, Iterator, TypeVar
+
+from batchai_retinanet_horovod_coco_tpu.data.pipeline import stop_gated_put
+
+_T = TypeVar("_T")
+_U = TypeVar("_U")
+
+
+def prefetch_map(
+    items: Iterable[_T],
+    transfer: Callable[[_T], _U],
+    depth: int = 2,
+    thread_name: str = "prefetch-map",
+) -> Iterator[_U]:
+    """Yield ``transfer(item)`` with a background thread running up to
+    ``depth`` items ahead of the consumer.
+
+    ``transfer`` runs IN THE PRODUCER THREAD — for device prefetch it calls
+    ``jax.device_put``, which enqueues the host→device DMA there, off the
+    consumer's critical path.  ``depth=2`` is classic double buffering;
+    ``depth <= 0`` degrades to a synchronous in-line map (debugging).
+
+    The returned generator's ``close()`` stops the thread deterministically;
+    exceptions from ``items`` or ``transfer`` re-raise here.
+    """
+    if depth <= 0:
+        for item in items:
+            yield transfer(item)
+        return
+
+    buf: queue.Queue = queue.Queue(maxsize=depth)
+    stop = threading.Event()
+    end = object()  # stream-exhausted sentinel
+
+    def _enqueue(item) -> bool:
+        return stop_gated_put(buf, item, stop)
+
+    def feeder() -> None:
+        try:
+            for item in items:
+                if not _enqueue(transfer(item)):
+                    return
+                if stop.is_set():
+                    return
+            _enqueue(end)
+        except BaseException as exc:  # propagate to the consumer
+            _enqueue(exc)
+
+    thread = threading.Thread(target=feeder, daemon=True, name=thread_name)
+    thread.start()
+    try:
+        while True:
+            item = buf.get()
+            if item is end:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()

@@ -10,6 +10,7 @@ has no pycocotools.
 from batchai_retinanet_horovod_coco_tpu.evaluate.coco_eval import (
     CocoEval,
     EvalParams,
+    StreamingCocoEval,
     evaluate_detections,
 )
 from batchai_retinanet_horovod_coco_tpu.evaluate.voc_eval import (
@@ -30,6 +31,7 @@ __all__ = [
     "CocoEval",
     "DetectConfig",
     "EvalParams",
+    "StreamingCocoEval",
     "coco_gt_from_dataset",
     "collect_detections",
     "compute_ap",

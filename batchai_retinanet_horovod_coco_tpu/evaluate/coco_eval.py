@@ -266,15 +266,22 @@ class CocoEval:
             "num_gt": int((~g_ignore).sum()),
         }
 
-    def evaluate(self) -> None:
+    def evaluate_image(self, img_id: int) -> None:
+        """Fill ``eval_imgs`` for ONE image across all (category, area)
+        cells — the unit both ``evaluate`` and the streaming scorer
+        (``StreamingCocoEval``) are built from, so their matching can
+        never diverge."""
         p = self.params
         max_det = p.max_dets[-1]
         for c, cat_id in enumerate(self.cat_ids):
             for a, area_rng in enumerate(p.area_rng):
-                for img_id in self.img_ids:
-                    self.eval_imgs[(c, a, img_id)] = self._evaluate_img(
-                        img_id, cat_id, area_rng, max_det
-                    )
+                self.eval_imgs[(c, a, img_id)] = self._evaluate_img(
+                    img_id, cat_id, area_rng, max_det
+                )
+
+    def evaluate(self) -> None:
+        for img_id in self.img_ids:
+            self.evaluate_image(img_id)
 
     # -- accumulate --------------------------------------------------------
 
@@ -377,6 +384,84 @@ _STAT_NAMES = (
     "AP", "AP50", "AP75", "APsmall", "APmedium", "APlarge",
     "AR1", "AR10", "AR100", "ARsmall", "ARmedium", "ARlarge",
 )
+
+
+class StreamingCocoEval:
+    """Incremental ``CocoEval``: feed detections batch-by-batch as they
+    come off the device; the per-image greedy matching (the dominant host
+    cost of an eval pass — O(images × categories × thresholds) of
+    numpy/C++ work) runs AS SOON AS an image's detections are complete,
+    instead of all at once after the last batch.  The pipelined
+    ``run_coco_eval`` (evaluate/detect.py) runs this inside its consumer
+    thread, overlapping scoring with device NMS of later batches.
+
+    Result-identical to the one-shot path, by construction: per-image
+    evaluation is independent across images (``_evaluate_img`` touches only
+    that image's annotations), and ``finish()`` runs the exact same
+    ``accumulate``/``summarize`` over the same ``eval_imgs`` table.  The
+    category list may be a SUPERSET of the categories that end up appearing
+    (it must be fixed before matching starts): categories with neither gt
+    nor detections evaluate to ``None`` everywhere and are excluded by
+    ``accumulate``/``summarize`` exactly as absent categories are, so the
+    stats match ``evaluate_detections`` bit-for-bit
+    (tests/unit/test_eval_pipeline.py pins this on randomized inputs).
+
+    Contract: ``add(dts, done_img_ids)`` marks images COMPLETE — every
+    detection for those images must be in this or an earlier call (the
+    eval pipeline satisfies this trivially: each image lives in exactly one
+    batch).  Detections for images already marked done are rejected loudly
+    rather than silently dropped from the score.
+    """
+
+    def __init__(
+        self,
+        gt_anns: list[dict],
+        img_ids: list[int],
+        cat_ids: list[int] | None = None,
+        params: EvalParams | None = None,
+    ):
+        self._ev = CocoEval(gt_anns, [], img_ids=img_ids, params=params)
+        if cat_ids is not None:
+            self._ev.cat_ids = sorted(set(self._ev.cat_ids) | set(cat_ids))
+        self._img_set = set(self._ev.img_ids)
+        self._done: set[int] = set()
+        self._finished = False
+
+    def add(self, dt_anns: list[dict], done_img_ids) -> None:
+        """Register a batch of detections and match the completed images."""
+        if self._finished:
+            raise RuntimeError("add() after finish()")
+        for a in dt_anns:
+            img_id = a["image_id"]
+            if img_id not in self._img_set:
+                continue
+            if img_id in self._done:
+                raise ValueError(
+                    f"detections for image {img_id} arrived after it was "
+                    "marked complete — they would be silently excluded "
+                    "from the score"
+                )
+            self._ev._dts.setdefault((img_id, a["category_id"]), []).append(a)
+        for img_id in done_img_ids:
+            img_id = int(img_id)
+            if img_id in self._done or img_id not in self._img_set:
+                continue
+            self._ev.evaluate_image(img_id)
+            self._done.add(img_id)
+
+    def finish(self) -> dict[str, float]:
+        """Match remaining images (gt-only / never streamed), then
+        accumulate + summarize → the same named stats dict as
+        ``evaluate_detections``."""
+        if not self._finished:
+            for img_id in self._ev.img_ids:
+                if img_id not in self._done:
+                    self._ev.evaluate_image(img_id)
+                    self._done.add(img_id)
+            self._ev.accumulate()
+            self._ev.summarize()
+            self._finished = True
+        return dict(zip(_STAT_NAMES, (float(s) for s in self._ev.stats)))
 
 
 def evaluate_detections(

@@ -12,12 +12,33 @@ on the TPU per BASELINE.json configs[4] ("on-device batched NMS").
 detect per static shape bucket (one compiled program each), rescale boxes to
 original image coordinates on host, and hand COCO-format results to the
 numpy mAP oracle (evaluate/coco_eval.py).
+
+Since ISSUE 2 the driver is a THREE-STAGE PIPELINE (default; the strictly
+sequential path survives as ``pipelined=False`` and stays bit-identical):
+
+1. **device prefetch** — the shared ``prefetch_map`` helper
+   (data/prefetch.py, the train loop's double-buffering machinery) moves
+   eval batches host→device up to ``device_prefetch`` batches ahead, so
+   detect compute overlaps the next batch's decode + DMA;
+2. **one-behind async dispatch** — the jitted detect program for batch N is
+   dispatched before batch N−1's results are pulled, so the host-side
+   ``device_get`` + box rescale + COCO-format conversion of batch N−1
+   overlap batch N's on-device NMS;
+3. **background scoring consumer** — conversion and (single-process)
+   incremental COCOeval matching (``StreamingCocoEval``) run in a consumer
+   thread behind a bounded queue with the shm-pipeline's error contract:
+   a consumer crash re-raises in the driver, ``close()`` never hangs.
+
+EVALBENCH.json is the committed perf record of this path (``bench.py
+--mode eval``; ``make evalbench-check`` is the regression tripwire).
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Iterable
+import queue
+import threading
+from typing import Any, Callable, Iterable, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -223,6 +244,112 @@ def coco_gt_from_dataset(dataset: CocoDataset) -> tuple[list[dict], list[int]]:
     return gts, [rec.image_id for rec in dataset.records]
 
 
+def _device_images(batch: Batch, mesh: Mesh | None):
+    """Enqueue one eval batch's images host→device (sharded over ``mesh``).
+
+    The eval twin of the train loop's ``_device_batch``: called from the
+    prefetch thread so the DMA dispatch happens off the detect-dispatch
+    path.  Process-local by design — multi-host eval runs on a LOCAL mesh
+    over this process's shard of the val set (train.py's eval hook).
+    """
+    if mesh is None:
+        return jax.device_put(batch.images)
+    from jax.sharding import NamedSharding
+
+    return jax.device_put(batch.images, NamedSharding(mesh, P(DATA_AXIS)))
+
+
+class _EvalConsumer:
+    """Stage-3 background consumer: device Detections → COCO result dicts
+    (+ optional per-batch scoring hook), behind a bounded queue.
+
+    Mirrors the shm pipeline's error contract
+    (tests/unit/test_eval_pipeline.py):
+
+    - a crash in the consumer (conversion or the scoring hook) re-raises
+      in the DRIVER at its next ``put()``/``finish()`` — never a silent
+      hang or a swallowed partial score;
+    - ``close()`` stops the thread promptly even mid-queue (both ends are
+      stop-gated) and is idempotent;
+    - batches are consumed FIFO by one thread, so ``results`` is ordered
+      exactly as the sequential path orders it (bit-identical output).
+    """
+
+    _DONE = object()
+
+    def __init__(
+        self,
+        label_to_cat_id: dict[int, int],
+        image_sizes: dict[int, tuple[int, int]] | None,
+        on_batch: Callable[[list[dict], Sequence[int]], None] | None = None,
+        maxsize: int = 4,
+    ):
+        self._label_to_cat_id = label_to_cat_id
+        self._image_sizes = image_sizes
+        self._on_batch = on_batch
+        self._queue: queue.Queue = queue.Queue(maxsize=max(1, maxsize))
+        self._stop = threading.Event()
+        self._error: BaseException | None = None
+        self.results: list[dict] = []
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="eval-consumer"
+        )
+        self._thread.start()
+
+    def _run(self) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    item = self._queue.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                if item is self._DONE:
+                    return
+                det, image_ids, scales, valid = item
+                batch_results = detections_to_coco(
+                    det,
+                    image_ids,
+                    scales,
+                    valid,
+                    self._label_to_cat_id,
+                    image_sizes=self._image_sizes,
+                )
+                self.results.extend(batch_results)
+                if self._on_batch is not None:
+                    done = [
+                        int(i) for i, v in zip(image_ids, valid) if v
+                    ]
+                    self._on_batch(batch_results, done)
+        except BaseException as exc:  # re-raised in the driver
+            self._error = exc
+            self._stop.set()  # unblock a driver waiting on a full queue
+
+    def _raise_pending(self) -> None:
+        if self._error is not None:
+            raise RuntimeError("eval consumer thread failed") from self._error
+
+    def put(self, det, image_ids, scales, valid) -> None:
+        """Hand one fetched batch to the consumer; raises its pending error."""
+        self._raise_pending()
+        if not pipeline_lib.stop_gated_put(
+            self._queue, (det, image_ids, scales, valid), self._stop
+        ):
+            self._raise_pending()
+            raise RuntimeError("eval consumer stopped")
+
+    def finish(self) -> list[dict]:
+        """Drain, join, surface any consumer error → ordered results."""
+        pipeline_lib.stop_gated_put(self._queue, self._DONE, self._stop)
+        self._thread.join()
+        self._raise_pending()
+        return self.results
+
+    def close(self) -> None:
+        """Abort without draining (driver unwinding on its own error)."""
+        self._stop.set()
+        self._thread.join(timeout=10)
+
+
 def collect_detections(
     state,
     model,
@@ -230,25 +357,44 @@ def collect_detections(
     batches: Iterable[Batch],
     config: DetectConfig = DetectConfig(),
     mesh: Mesh | None = None,
+    *,
+    pipelined: bool = True,
+    device_prefetch: int = 2,
+    detect_fns: dict[tuple[int, int], Callable] | None = None,
+    on_batch: Callable[[list[dict], Sequence[int]], None] | None = None,
 ) -> list[dict]:
     """Run detection over an eval batch stream → COCO result dicts.
 
     One detect function is compiled per shape bucket encountered (static
-    shapes, SURVEY.md §7.3 hard part 1); the cache keys on (H, W).
+    shapes, SURVEY.md §7.3 hard part 1); the cache keys on (H, W).  Pass
+    ``detect_fns`` to share compiled programs across calls (the eval bench
+    times sequential vs pipelined on the same executables).
+
+    ``pipelined`` selects the three-stage overlapped driver (module
+    docstring); ``False`` is the strictly sequential reference path.  Both
+    produce identical results in identical order
+    (tests/unit/test_eval_pipeline.py pins bitwise equality).  ``on_batch``
+    (if given) observes each batch's converted results plus the image ids
+    it completed — in the consumer THREAD when pipelined, inline otherwise.
     """
-    detect_fns: dict[tuple[int, int], Callable] = {}
+    if detect_fns is None:
+        detect_fns = {}
     image_sizes = {
         rec.image_id: (rec.width, rec.height) for rec in dataset.records
     }
-    results: list[dict] = []
-    for batch in batches:
-        hw = batch.images.shape[1:3]
+
+    def fn_for(hw: tuple[int, int]) -> Callable:
         fn = detect_fns.get(hw)
         if fn is None:
             fn = detect_fns[hw] = make_detect_fn(model, hw, config, mesh=mesh)
-        det = jax.device_get(fn(state, jnp.asarray(batch.images)))
-        results.extend(
-            detections_to_coco(
+        return fn
+
+    if not pipelined:
+        results: list[dict] = []
+        for batch in batches:
+            hw = batch.images.shape[1:3]
+            det = jax.device_get(fn_for(hw)(state, jnp.asarray(batch.images)))
+            batch_results = detections_to_coco(
                 det,
                 batch.image_ids,
                 batch.scales,
@@ -256,8 +402,52 @@ def collect_detections(
                 dataset.label_to_cat_id,
                 image_sizes=image_sizes,
             )
-        )
-    return results
+            results.extend(batch_results)
+            if on_batch is not None:
+                on_batch(
+                    batch_results,
+                    [int(i) for i, v in zip(batch.image_ids, batch.valid) if v],
+                )
+        return results
+
+    from batchai_retinanet_horovod_coco_tpu.data.prefetch import prefetch_map
+
+    consumer = _EvalConsumer(
+        dataset.label_to_cat_id, image_sizes, on_batch=on_batch
+    )
+    # Stage 1: host→device transfer runs in the prefetch thread, ``depth``
+    # batches ahead of dispatch.  Shape/metadata stay host-side.
+    staged = prefetch_map(
+        batches,
+        lambda b: (
+            b.images.shape,
+            _device_images(b, mesh),
+            b.image_ids,
+            b.scales,
+            b.valid,
+        ),
+        depth=device_prefetch,
+        thread_name="eval-device-prefetch",
+    )
+    # Stage 2: dispatch batch N, then pull batch N−1 (its program has
+    # already finished or is ahead in the device stream): the device_get +
+    # conversion of N−1 overlap N's forward+NMS on device.
+    pending: tuple | None = None
+    try:
+        for shape, images_dev, image_ids, scales, valid in staged:
+            det = fn_for(shape[1:3])(state, images_dev)  # async dispatch
+            if pending is not None:
+                prev_det, prev_meta = pending
+                consumer.put(jax.device_get(prev_det), *prev_meta)
+            pending = (det, (image_ids, scales, valid))
+        if pending is not None:
+            prev_det, prev_meta = pending
+            pending = None
+            consumer.put(jax.device_get(prev_det), *prev_meta)
+        return consumer.finish()
+    finally:
+        staged.close()
+        consumer.close()
 
 
 def allgather_process_detections(results: list[dict]) -> list[dict]:
@@ -326,8 +516,20 @@ def run_coco_eval(
     voc_metrics: bool = False,
     voc_weighted_average: bool = False,
     gather: bool = True,
+    pipelined: bool = True,
+    device_prefetch: int = 2,
+    detect_fns: dict[tuple[int, int], Callable] | None = None,
 ) -> dict[str, float]:
     """Full eval pass: detect everything, then mAP via the numpy oracle.
+
+    ``pipelined`` (default) runs the three-stage overlapped driver (module
+    docstring): prefetch → one-behind async detect → background consumer.
+    When the detections need no cross-process merge, the consumer
+    additionally scores INCREMENTALLY (``StreamingCocoEval``), so the
+    per-image COCO matching overlaps device NMS instead of running as a
+    serial epilogue; metrics are identical either way
+    (tests/unit/test_eval_pipeline.py).  ``pipelined=False`` is the
+    strictly sequential reference path.
 
     With ``voc_metrics``, the same detection pass additionally yields
     PASCAL-VOC AP@0.5 per class (the reference's ``Evaluate`` callback
@@ -340,11 +542,36 @@ def run_coco_eval(
     merge here via ``allgather_process_detections`` (``gather=False`` skips
     the merge for a deliberately process-local eval).
     """
-    dt = collect_detections(state, model, dataset, batches, config, mesh=mesh)
+    gt, img_ids = coco_gt_from_dataset(dataset)
+    # Streaming scoring needs the full result set to BE this process's
+    # result set: with a pending cross-process merge, score post-gather.
+    scorer = None
+    if pipelined and (not gather or jax.process_count() == 1):
+        from batchai_retinanet_horovod_coco_tpu.evaluate.coco_eval import (
+            StreamingCocoEval,
+        )
+
+        scorer = StreamingCocoEval(
+            gt, img_ids, cat_ids=list(dataset.label_to_cat_id.values())
+        )
+    dt = collect_detections(
+        state,
+        model,
+        dataset,
+        batches,
+        config,
+        mesh=mesh,
+        pipelined=pipelined,
+        device_prefetch=device_prefetch,
+        detect_fns=detect_fns,
+        on_batch=scorer.add if scorer is not None else None,
+    )
     if gather:
         dt = allgather_process_detections(dt)
-    gt, img_ids = coco_gt_from_dataset(dataset)
-    metrics = evaluate_detections(gt, dt, img_ids=img_ids)
+    if scorer is not None:
+        metrics = scorer.finish()
+    else:
+        metrics = evaluate_detections(gt, dt, img_ids=img_ids)
     if voc_metrics:
         metrics.update(
             evaluate_detections_voc(

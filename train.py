@@ -206,6 +206,12 @@ def build_parser() -> argparse.ArgumentParser:
         g.add_argument("--checkpoint-every", type=int, default=1000)
         g.add_argument("--no-resume", action="store_true")
         g.add_argument("--eval-every", type=int, default=0)
+        g.add_argument("--async-eval", action="store_true",
+                       help="run the mid-training eval hook in a background "
+                            "thread on a snapshotted param copy instead of "
+                            "blocking the step stream (single-process only; "
+                            "multi-host falls back to synchronous — "
+                            "train/loop.py::_AsyncEvalRunner)")
         g.add_argument("--log-every", type=int, default=20)
         g.add_argument("--log-dir", default=None)
         g.add_argument("--tensorboard", action="store_true")
@@ -780,6 +786,7 @@ def main(argv=None) -> dict[str, float]:
             resume=not args.no_resume,
             profile_dir=args.profile_dir,
             device_prefetch=args.device_prefetch,
+            async_eval=args.async_eval,
         ),
         mesh=mesh,
         schedule=schedule,

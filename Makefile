@@ -20,7 +20,7 @@ CLUSTER = python -m batchai_retinanet_horovod_coco_tpu.launch.cluster
 
 .PHONY: create submit status delete test test-timings smoke bench \
 	bench-check bench-pipeline pipebench pipebench-check evalbench \
-	evalbench-check canaries convergence-full
+	evalbench-check canaries convergence-full lint-obs
 
 create:
 	$(CLUSTER) create --name $(NAME) --zone $(ZONE) --accelerator $(ACCEL) $(DRYFLAG)
@@ -83,6 +83,13 @@ evalbench-check:
 # scripts/xla_repros/ISSUES.md.
 canaries:
 	python -m pytest tests/distributed/test_spatial_train.py -q -k canary
+
+# Static watchdog-coverage audit (ISSUE 3, sibling of audit_collectives):
+# every threading.Thread/mp.Process spawn site in the package must
+# register with the obs watchdog or carry a '# watchdog: <why>' rationale.
+# Also runs in tier-1 (tests/unit/test_obs.py::test_audit_threads_clean).
+lint-obs:
+	python scripts/audit_threads.py
 
 # Host input-pipeline bench: threads-vs-procs sweep (bench_pipeline.py).
 # pipebench-check is the regression tripwire twin of bench-check: measured

@@ -41,6 +41,7 @@ logger = logging.getLogger(__name__)
 from batchai_retinanet_horovod_coco_tpu.data.transforms import cv2  # shared fallback
 
 from batchai_retinanet_horovod_coco_tpu.data.coco import CocoDataset, ImageRecord
+from batchai_retinanet_horovod_coco_tpu.obs import trace, watchdog
 from batchai_retinanet_horovod_coco_tpu.data.transforms import (
     TransformConfig,
     apply_random_transform,
@@ -494,15 +495,22 @@ def build_pipeline(
         return stop_gated_put(out, item, stop)
 
     def producer() -> None:
+        # watchdog-exempt (pool): decode-pool threads surface through
+        # future.result() on THIS (registered) thread — a wedged decode
+        # stalls the producer heartbeat, which is the attributable signal.
         pool = ThreadPoolExecutor(max_workers=config.num_workers)
+        hb = watchdog.register(
+            "pipe-producer", details=lambda: {"qsize": out.qsize()}
+        )
         try:
-            _produce(pool)
+            _produce(pool, hb)
         except BaseException as exc:  # propagate to the consumer; never hang
             _put(exc)
         finally:
+            hb.close()
             pool.shutdown(wait=False)
 
-    def _produce(pool: ThreadPoolExecutor) -> None:
+    def _produce(pool: ThreadPoolExecutor, hb) -> None:
             from collections import deque
 
             # Keep several batches' decode futures in flight so the pool
@@ -517,11 +525,17 @@ def build_pipeline(
 
             def flush_one() -> bool:
                 futures, ids, bucket, short = inflight.popleft()
-                examples = [f.result() for f in futures]
-                batch = _assemble(examples, ids, bucket, config, stats)
+                with trace.span("pipe_decode_wait"):
+                    examples = [f.result() for f in futures]
+                hb.beat()  # decode progress = fleet liveness
+                with trace.span("pipe_assemble"):
+                    batch = _assemble(examples, ids, bucket, config, stats)
                 if short:
                     batch = _pad_batch(batch, config.batch_size)
-                return _put(batch)
+                hb.idle()  # a full output queue is backpressure, not a stall
+                ok = _put(batch)
+                hb.beat()
+                return ok
 
             epoch = 0
             while not stop.is_set():
@@ -550,7 +564,10 @@ def build_pipeline(
                     return
                 epoch += 1
 
-    thread = threading.Thread(target=producer, daemon=True)
+    # watchdog: registers in producer() at thread start.
+    thread = threading.Thread(
+        target=producer, daemon=True, name="pipe-producer"
+    )
     thread.start()
 
     def iterate() -> Iterator[Batch]:

@@ -23,6 +23,7 @@ import threading
 from typing import Callable, Iterable, Iterator, TypeVar
 
 from batchai_retinanet_horovod_coco_tpu.data.pipeline import stop_gated_put
+from batchai_retinanet_horovod_coco_tpu.obs import trace, watchdog
 
 _T = TypeVar("_T")
 _U = TypeVar("_U")
@@ -58,16 +59,35 @@ def prefetch_map(
         return stop_gated_put(buf, item, stop)
 
     def feeder() -> None:
+        # Observability (obs/): every produced item is a heartbeat and a
+        # span on this thread's trace track; the queue depth is a counter.
+        # ``idle()`` before the bounded put — blocking on a full queue is
+        # backpressure from a busy consumer, not a stall.
+        hb = watchdog.register(
+            thread_name, details=lambda: {"qsize": buf.qsize(), "depth": depth}
+        )
         try:
             for item in items:
-                if not _enqueue(transfer(item)):
+                with trace.span(thread_name):
+                    staged = transfer(item)
+                hb.beat()
+                hb.idle()
+                if not _enqueue(staged):
                     return
+                hb.beat()
+                if trace.enabled():
+                    trace.counter(f"{thread_name}.qsize", buf.qsize())
                 if stop.is_set():
                     return
+            hb.idle()  # sentinel delivery blocks on the same backpressure
             _enqueue(end)
         except BaseException as exc:  # propagate to the consumer
+            hb.idle()
             _enqueue(exc)
+        finally:
+            hb.close()
 
+    # watchdog: registers in feeder() at thread start.
     thread = threading.Thread(target=feeder, daemon=True, name=thread_name)
     thread.start()
     try:

@@ -64,6 +64,7 @@ from batchai_retinanet_horovod_coco_tpu.data.pipeline import (
     load_example,
     stop_gated_put,
 )
+from batchai_retinanet_horovod_coco_tpu.obs import trace, watchdog
 
 _SENTINEL = object()
 _SHM_PREFIX = "bretshm"  # distinctive: tests scan /dev/shm for leaks
@@ -125,6 +126,11 @@ def _worker_main(
     import signal
 
     signal.signal(signal.SIGINT, signal.SIG_IGN)  # parent owns Ctrl-C
+    # Tracing self-enables iff the parent exported the obs env contract
+    # before the spawn; the decode spans land in this process's own trace
+    # file (exported on clean exit) and merge into the parent's timeline.
+    # obs.trace never imports jax, preserving this worker's no-jax rule.
+    tracing = trace.maybe_configure_from_env(f"shm-worker-{worker_id}")
     try:
         from batchai_retinanet_horovod_coco_tpu.data.transforms import cv2
 
@@ -150,16 +156,21 @@ def _worker_main(
                 break
             seq, epoch, idx, bucket_id, slot = task
             record = dataset.records[idx]
-            img, boxes, labels, scale = load_example(
-                dataset,
-                record,
-                config,
-                example_rng(config, train, epoch, idx),
-                config.buckets[bucket_id],
-            )
+            with trace.span("decode"):
+                img, boxes, labels, scale = load_example(
+                    dataset,
+                    record,
+                    config,
+                    example_rng(config, train, epoch, idx),
+                    config.buckets[bucket_id],
+                )
             h, w = img.shape[:2]
-            views[bucket_id][slot, :h, :w] = img
+            with trace.span("shm_write"):
+                views[bucket_id][slot, :h, :w] = img
             result_q.put(("ok", seq, h, w, boxes, labels, scale))
+        if tracing:
+            trace.export()  # clean exit only; a crashed worker's trace is
+            # forfeit (os._exit below), the parent's diagnosis carries on
     except BaseException:
         try:
             result_q.put(("err", worker_id, traceback.format_exc()))
@@ -310,6 +321,10 @@ class _ShmPipeline:
         self._task_q = ctx.Queue()
         self._result_q = ctx.Queue()
         self._mp_stop = ctx.Event()
+        # watchdog-exempt (workers): decode workers heartbeat IMPLICITLY
+        # through the result queue — the coordinator (registered in
+        # _producer) beats a shm-pipe component per arriving result, so a
+        # dead/wedged fleet stops that heartbeat within one task.
         self.processes = [
             ctx.Process(
                 target=_worker_main,
@@ -351,6 +366,8 @@ class _ShmPipeline:
         )
         for p in self.processes:
             p.start()
+        self._hb = None  # registered by the coordinator thread itself
+        # watchdog: registers in _producer() at thread start.
         self._thread = threading.Thread(
             target=self._producer, daemon=True, name="shm-pipe-coordinator"
         )
@@ -457,7 +474,11 @@ class _ShmPipeline:
                 self._results[seq] = (h, w, boxes, labels, scale)
                 # Any arriving result IS progress: the timeout bounds a
                 # STALL, not total head-batch latency (expensive decodes
-                # trickling in steadily must never trip it).
+                # trickling in steadily must never trip it).  The same
+                # arrival is the worker fleet's implicit watchdog
+                # heartbeat (workers never register themselves).
+                if self._hb is not None:
+                    self._hb.beat()
                 deadline = time.monotonic() + self._config.worker_timeout
                 continue
             self._check_workers()
@@ -482,7 +503,8 @@ class _ShmPipeline:
 
     def _flush_head(self) -> None:
         bucket, bucket_id, seqs, ids, short = self._inflight[0]
-        self._pump_until(lambda: all(s in self._results for s in seqs))
+        with trace.span("shm_head_wait"):
+            self._pump_until(lambda: all(s in self._results for s in seqs))
         self._inflight.popleft()
         examples = []
         slots = []
@@ -495,11 +517,20 @@ class _ShmPipeline:
             )
         # _assemble copies the shm views into a fresh batch, so the slots
         # can recycle immediately and the consumer never aliases the ring.
-        batch = _assemble(examples, ids, bucket, self._config, self.stats)
+        with trace.span("shm_assemble"):
+            batch = _assemble(examples, ids, bucket, self._config, self.stats)
         self._free[bucket_id].extend(slots)
         if short:
             batch = _pad_batch(batch, self._config.batch_size)
-        if not self._put(batch):
+        if trace.enabled():
+            trace.counter("shm.out_qsize", self._out.qsize())
+            trace.counter("shm.inflight_batches", len(self._inflight))
+        if self._hb is not None:
+            self._hb.idle()  # blocked on a full output queue = backpressure
+        ok = self._put(batch)
+        if self._hb is not None:
+            self._hb.beat()
+        if not ok:
             raise _StopRequested
 
     def _produce(self) -> None:
@@ -535,6 +566,19 @@ class _ShmPipeline:
         )
 
     def _producer(self) -> None:
+        self._hb = watchdog.register(
+            "shm-pipe-coordinator",
+            # One heartbeat covers coordinator AND fleet: it beats on every
+            # worker result (_pump_until) and every delivered batch
+            # (_flush_head); details snapshot the queue/slot state a stall
+            # diagnosis needs.
+            details=lambda: {
+                "out_qsize": self._out.qsize(),
+                "inflight_batches": len(self._inflight),
+                "pending_results": len(self._results),
+                "workers_alive": sum(p.is_alive() for p in self.processes),
+            },
+        )
         try:
             self._produce()
         except _StopRequested:
@@ -549,6 +593,8 @@ class _ShmPipeline:
             self._cleanup()
             self._put(exc)
             return
+        finally:
+            self._hb.close()  # a closed pipeline must not look "stalled"
         self._cleanup()
 
 

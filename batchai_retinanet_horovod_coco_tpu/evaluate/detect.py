@@ -55,6 +55,7 @@ from batchai_retinanet_horovod_coco_tpu.evaluate.coco_eval import evaluate_detec
 from batchai_retinanet_horovod_coco_tpu.evaluate.voc_eval import (
     evaluate_detections_voc,
 )
+from batchai_retinanet_horovod_coco_tpu.obs import trace, watchdog
 from batchai_retinanet_horovod_coco_tpu.ops import anchors as anchors_lib
 from batchai_retinanet_horovod_coco_tpu.ops import boxes as boxes_lib
 from batchai_retinanet_horovod_coco_tpu.ops import nms as nms_lib
@@ -291,14 +292,27 @@ class _EvalConsumer:
         self._stop = threading.Event()
         self._error: BaseException | None = None
         self.results: list[dict] = []
+        # watchdog: registers in _run() at thread start.
         self._thread = threading.Thread(
             target=self._run, daemon=True, name="eval-consumer"
         )
         self._thread.start()
 
     def _run(self) -> None:
+        # Every poll iteration beats (the idle get(timeout) included — a
+        # waiting consumer is healthy); only a WEDGED conversion/scoring
+        # callback stops the heartbeat, which is exactly the previously
+        # invisible failure the watchdog exists to name (ISSUE 3).
+        hb = watchdog.register(
+            "eval-consumer",
+            details=lambda: {
+                "qsize": self._queue.qsize(),
+                "results": len(self.results),
+            },
+        )
         try:
             while not self._stop.is_set():
+                hb.beat()
                 try:
                     item = self._queue.get(timeout=0.1)
                 except queue.Empty:
@@ -306,23 +320,29 @@ class _EvalConsumer:
                 if item is self._DONE:
                     return
                 det, image_ids, scales, valid = item
-                batch_results = detections_to_coco(
-                    det,
-                    image_ids,
-                    scales,
-                    valid,
-                    self._label_to_cat_id,
-                    image_sizes=self._image_sizes,
-                )
+                with trace.span("eval_convert"):
+                    batch_results = detections_to_coco(
+                        det,
+                        image_ids,
+                        scales,
+                        valid,
+                        self._label_to_cat_id,
+                        image_sizes=self._image_sizes,
+                    )
                 self.results.extend(batch_results)
                 if self._on_batch is not None:
                     done = [
                         int(i) for i, v in zip(image_ids, valid) if v
                     ]
-                    self._on_batch(batch_results, done)
+                    with trace.span("eval_score"):
+                        self._on_batch(batch_results, done)
+                if trace.enabled():
+                    trace.counter("eval_consumer.qsize", self._queue.qsize())
         except BaseException as exc:  # re-raised in the driver
             self._error = exc
             self._stop.set()  # unblock a driver waiting on a full queue
+        finally:
+            hb.close()
 
     def _raise_pending(self) -> None:
         if self._error is not None:
@@ -386,7 +406,13 @@ def collect_detections(
     def fn_for(hw: tuple[int, int]) -> Callable:
         fn = detect_fns.get(hw)
         if fn is None:
-            fn = detect_fns[hw] = make_detect_fn(model, hw, config, mesh=mesh)
+            # AOT point: the jit wrapper is built here and compiles at its
+            # first dispatch — mark it so a trace attributes the one-time
+            # multi-second gap per bucket to compilation, not a stall.
+            with trace.span("build_detect_fn", bucket=f"{hw[0]}x{hw[1]}"):
+                fn = detect_fns[hw] = make_detect_fn(
+                    model, hw, config, mesh=mesh
+                )
         return fn
 
     if not pipelined:
@@ -431,23 +457,46 @@ def collect_detections(
     )
     # Stage 2: dispatch batch N, then pull batch N−1 (its program has
     # already finished or is ahead in the device stream): the device_get +
-    # conversion of N−1 overlap N's forward+NMS on device.
+    # conversion of N−1 overlap N's forward+NMS on device.  The driver
+    # carries its own heartbeat: the consumer beats on every idle poll and
+    # the prefetch thread idles behind a full queue, so a wedge HERE —
+    # device_get hanging on a dead device stream is the canonical one —
+    # would otherwise be the only component with no liveness signal.
+    hb = watchdog.register(
+        "eval-driver", details=lambda: {"results": len(consumer.results)}
+    )
     pending: tuple | None = None
+
+    def fetch(det):
+        with trace.span("detect_fetch"):
+            fetched = jax.device_get(det)
+        hb.beat()
+        return fetched
+
     try:
         for shape, images_dev, image_ids, scales, valid in staged:
-            det = fn_for(shape[1:3])(state, images_dev)  # async dispatch
+            hb.beat()
+            with trace.span("detect_dispatch"):
+                det = fn_for(shape[1:3])(state, images_dev)  # async dispatch
             if pending is not None:
                 prev_det, prev_meta = pending
-                consumer.put(jax.device_get(prev_det), *prev_meta)
+                fetched = fetch(prev_det)
+                hb.idle()  # a full consumer queue is backpressure
+                consumer.put(fetched, *prev_meta)
+                hb.beat()
             pending = (det, (image_ids, scales, valid))
         if pending is not None:
             prev_det, prev_meta = pending
             pending = None
-            consumer.put(jax.device_get(prev_det), *prev_meta)
+            fetched = fetch(prev_det)
+            hb.idle()
+            consumer.put(fetched, *prev_meta)
+        hb.idle()  # finish() legitimately blocks on the consumer's drain
         return consumer.finish()
     finally:
         staged.close()
         consumer.close()
+        hb.close()
 
 
 def allgather_process_detections(results: list[dict]) -> list[dict]:

@@ -13,7 +13,6 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-import time
 import warnings
 from typing import Any, Callable, Iterable, Iterator
 
@@ -37,6 +36,9 @@ from batchai_retinanet_horovod_coco_tpu.train.step import (
     make_train_step,
     make_train_step_spatial,
 )
+from batchai_retinanet_horovod_coco_tpu.obs import trace, watchdog
+from batchai_retinanet_horovod_coco_tpu.obs.events import device_memory_stats
+from batchai_retinanet_horovod_coco_tpu.obs.trace import monotonic_s
 from batchai_retinanet_horovod_coco_tpu.utils.checkpoint import CheckpointManager
 from batchai_retinanet_horovod_coco_tpu.utils.metrics import MetricLogger
 
@@ -204,13 +206,24 @@ class _AsyncEvalRunner:
         snapshot = jax.tree.map(jnp.copy, state.replace(opt_state=()))
 
         def run() -> None:
+            # Registered but immediately idle: a mid-training eval is
+            # minutes of legitimate silence, and its LIVENESS is witnessed
+            # by the components the eval itself spins up (eval-device-
+            # prefetch, eval-consumer, the val pipeline's producer) — a
+            # wedged eval shows up as THEIR stall, correctly attributed.
+            hb = watchdog.register("async-eval")
+            hb.idle()
             try:
-                metrics = self._eval_fn(snapshot)
+                with trace.span("async_eval", step=step):
+                    metrics = self._eval_fn(snapshot)
                 with self._lock:
                     self._done.append((step, metrics))
             except BaseException as exc:  # surfaced at the next drain/join
                 self._error = exc
+            finally:
+                hb.close()
 
+        # watchdog: registers in run() at thread start.
         self._thread = threading.Thread(
             target=run, daemon=True, name="async-eval"
         )
@@ -414,7 +427,7 @@ def run_training(
         max(start_step + 1, config.total_steps - config.profile_steps + 1),
     )
     prof_end = min(config.total_steps, prof_start + config.profile_steps - 1)
-    window_t0 = time.perf_counter()
+    window_t0 = monotonic_s()
     window_images = 0
     window_data_wait = 0.0  # host time blocked on the input pipeline
     window_steps = 0
@@ -431,47 +444,83 @@ def run_training(
         else:
             eval_runner = _AsyncEvalRunner(eval_fn, logger)
     it = _prefetch_to_device(batches, mesh, config.device_prefetch)
+    # The loop's own heartbeat: one beat per step.  Long legitimate gaps
+    # (sync eval, final epilogue) are bracketed with idle() so only a
+    # genuinely wedged step stream — or the data stall it is blocked on —
+    # trips the watchdog.  The details cell is HOST-side: the watchdog
+    # thread must never touch ``state.step`` (a possibly-donated device
+    # array).
+    last_step = [start_step]
+    loop_hb = watchdog.register(
+        "train-loop", details=lambda: {"step": last_step[0]}
+    )
 
     try:
         for step in range(start_step + 1, config.total_steps + 1):
             if eval_runner is not None:
                 eval_runner.drain()  # log finished evals; surface failures
-            t_data = time.perf_counter()
-            images_shape, device_arrays = next(it)
-            window_data_wait += time.perf_counter() - t_data
+            loop_hb.beat()
+            last_step[0] = step
+            t_data = monotonic_s()
+            with trace.span("data_wait"):
+                images_shape, device_arrays = next(it)
+            window_data_wait += monotonic_s() - t_data
             window_steps += 1
             hw = images_shape[1:3]
             step_fn = step_fns.get(hw)
             if step_fn is None:
-                if spatial:
-                    step_fn = step_fns[hw] = make_train_step_spatial(
-                        model,
-                        hw,
-                        num_classes,
-                        mesh=mesh,
-                        loss_config=loss_config,
-                        matching_config=matching_config,
-                        anchor_config=anchor_config,
-                        allow_data_axis_divergence=allow_data_axis_divergence,
+                # AOT point: build + (multi-process) compile-and-barrier.
+                # The span/event turn each bucket's one-time multi-minute
+                # gap into an attributed compile, not an apparent stall —
+                # and the heartbeat goes idle for the same reason (a cold
+                # flagship compile is minutes, far past any stall budget).
+                loop_hb.idle()
+                t_compile = monotonic_s()
+                with trace.span(
+                    "compile_train_step", bucket=f"{hw[0]}x{hw[1]}"
+                ):
+                    if spatial:
+                        step_fn = step_fns[hw] = make_train_step_spatial(
+                            model,
+                            hw,
+                            num_classes,
+                            mesh=mesh,
+                            loss_config=loss_config,
+                            matching_config=matching_config,
+                            anchor_config=anchor_config,
+                            allow_data_axis_divergence=allow_data_axis_divergence,
+                        )
+                    else:
+                        step_fn = step_fns[hw] = make_train_step(
+                            model,
+                            hw,
+                            num_classes,
+                            mesh=mesh,
+                            loss_config=loss_config,
+                            matching_config=matching_config,
+                            anchor_config=anchor_config,
+                            shard_weight_update=shard_weight_update,
+                            quantized_allreduce=quantized_allreduce,
+                        )
+                    # No process may enter the step's collectives while a
+                    # peer is still compiling (collective timeouts <<
+                    # compile times).
+                    _compile_barrier(step_fn, state, device_arrays, hw)
+                loop_hb.beat()
+                # Duck-typed: tests pass bare .log-only logger fakes.
+                log_event = getattr(logger, "event", None)
+                if log_event is not None:
+                    log_event(
+                        "compile",
+                        target="train_step",
+                        bucket=f"{hw[0]}x{hw[1]}",
+                        step=step,
+                        build_s=round(monotonic_s() - t_compile, 3),
                     )
-                else:
-                    step_fn = step_fns[hw] = make_train_step(
-                        model,
-                        hw,
-                        num_classes,
-                        mesh=mesh,
-                        loss_config=loss_config,
-                        matching_config=matching_config,
-                        anchor_config=anchor_config,
-                        shard_weight_update=shard_weight_update,
-                        quantized_allreduce=quantized_allreduce,
-                    )
-                # No process may enter the step's collectives while a peer is
-                # still compiling (collective timeouts << compile times).
-                _compile_barrier(step_fn, state, device_arrays, hw)
             if config.profile_dir and step == prof_start:
                 jax.profiler.start_trace(config.profile_dir)
-            state, metrics = step_fn(state, device_arrays)
+            with trace.span("step"):
+                state, metrics = step_fn(state, device_arrays)
             if config.profile_dir and step == prof_end:
                 jax.block_until_ready(metrics)
                 jax.profiler.stop_trace()
@@ -504,11 +553,14 @@ def run_training(
                         )
 
             if is_log:
-                scalars = {k: v for k, v in jax.device_get(metrics).items()}
+                with trace.span("metrics_fetch"):
+                    scalars = {
+                        k: v for k, v in jax.device_get(metrics).items()
+                    }
                 for name in _SENTINEL_METRICS:
                     if name in scalars:
                         _assert_finite(scalars[name], name, step, cadence)
-                dt = time.perf_counter() - window_t0
+                dt = monotonic_s() - window_t0
                 scalars["images_per_sec"] = window_images / max(dt, 1e-9)
                 # Step-time breakdown (SURVEY.md §5.5): how much of the step the
                 # host spent BLOCKED on the input pipeline — the classic
@@ -529,7 +581,13 @@ def run_training(
                     if scale is not None:
                         scalars["lr"] *= scale  # data-driven ReduceLROnPlateau
                 logger.log(step, scalars)
-                window_t0 = time.perf_counter()
+                if trace.enabled():
+                    # Device HBM occupancy as Chrome counter tracks, once
+                    # per log window (memory_stats() is a host call; CPU
+                    # backends report nothing and this is a no-op).
+                    for name, value in device_memory_stats():
+                        trace.counter(name, value)
+                window_t0 = monotonic_s()
                 window_images = 0
                 window_data_wait = 0.0
                 window_steps = 0
@@ -544,16 +602,30 @@ def run_training(
                 and step < config.total_steps
             ):
                 if eval_runner is not None:
-                    # Non-blocking: the hook runs on a snapshotted copy
-                    # while the step stream continues.  No window reset —
-                    # the steps keep flowing (the eval's device work shows
-                    # up honestly as slightly slower steps, not as a gap).
+                    # Usually non-blocking: the hook runs on a snapshotted
+                    # copy while the step stream continues.  No window
+                    # reset — the steps keep flowing (the eval's device
+                    # work shows up honestly as slightly slower steps, not
+                    # as a gap).  BUT launch() first joins a still-running
+                    # previous eval (one in flight max), which can block
+                    # for minutes when eval_every < eval duration — idle
+                    # the loop heartbeat across it, as the sync branch
+                    # below does.
+                    loop_hb.idle()
                     eval_runner.launch(state, step)
+                    loop_hb.beat()
                 else:
-                    logger.log(step, eval_fn(state), prefix="eval")
+                    # Synchronous eval: minutes of legitimate step-stream
+                    # silence — idle the loop heartbeat (the eval's own
+                    # components carry liveness) and re-arm after.
+                    loop_hb.idle()
+                    with trace.span("eval", step=step):
+                        eval_metrics = eval_fn(state)
+                    loop_hb.beat()
+                    logger.log(step, eval_metrics, prefix="eval")
                     # Eval time must not pollute the next window's
                     # step-time metrics.
-                    window_t0 = time.perf_counter()
+                    window_t0 = monotonic_s()
                     window_images = 0
                     window_data_wait = 0.0
                     window_steps = 0
@@ -573,6 +645,9 @@ def run_training(
         # Stop the prefetch thread deterministically (even when the
         # loop exits via an exception) before eval/checkpoint epilogue.
         it.close()
+        # The step stream is over; the epilogue (final eval, checkpoint
+        # flush) has its own components/timeouts.
+        loop_hb.close()
 
     final_step = max(start_step, config.total_steps)
     if eval_runner is not None:
@@ -580,7 +655,9 @@ def run_training(
         # order) any still-running mid-run eval first.
         eval_runner.join()
     if eval_fn is not None:
-        logger.log(final_step, eval_fn(state), prefix="eval")
+        with trace.span("final_eval", step=final_step):
+            final_metrics = eval_fn(state)
+        logger.log(final_step, final_metrics, prefix="eval")
     if ckpt is not None:
         if last_saved != final_step:
             ckpt.save(state, step=final_step, force=True)

@@ -80,6 +80,48 @@ def add_data_pipeline_flags(parser) -> None:
                              "buffering); 0 = synchronous transfer")
 
 
+def add_obs_flags(parser) -> None:
+    """The observability flag surface (train.py / evaluate.py; ISSUE 3).
+
+    One definition so every tool that grows tracing exposes the same
+    knobs.  With both flags off the subsystem costs nothing: spans check
+    one module-level bool and heartbeats are attribute stores."""
+    parser.add_argument("--obs-trace", action="store_true",
+                        help="record trace spans (step loop, data "
+                             "pipeline, shm decode workers, prefetch, "
+                             "eval consumer) and export a Perfetto-"
+                             "loadable Chrome trace JSON into --obs-dir "
+                             "at exit (obs/trace.py)")
+    parser.add_argument("--obs-dir", default=None,
+                        help="observability artifact directory (trace "
+                             "JSON, watchdog stack dumps); default "
+                             "artifacts/obs when --obs-trace is set")
+    parser.add_argument("--obs-stall-timeout", type=float, default=120.0,
+                        help="seconds a registered component may go "
+                             "without a heartbeat before the watchdog "
+                             "dumps a stall diagnosis (structured JSON + "
+                             "all-thread stacks; it never kills the run "
+                             "— obs/watchdog.py).  Only takes effect "
+                             "with --obs-trace/--obs-dir (the subsystem "
+                             "is otherwise fully disabled)")
+
+
+def configure_obs(args, process_label: str = "main", sink=None):
+    """Bring up the obs subsystem from the flags above; returns the obs
+    dir (None = disabled).  Call BEFORE building pipelines so spawned shm
+    workers inherit the trace env contract."""
+    if not (getattr(args, "obs_trace", False) or getattr(args, "obs_dir", None)):
+        return None
+    from batchai_retinanet_horovod_coco_tpu import obs
+
+    return obs.enable(
+        args.obs_dir or "artifacts/obs",
+        process_label=process_label,
+        stall_after=getattr(args, "obs_stall_timeout", 120.0),
+        sink=sink,
+    )
+
+
 def make_pipeline_worker_kwargs(args) -> dict:
     """PipelineConfig kwargs for the worker/prefetch flags above."""
     return dict(

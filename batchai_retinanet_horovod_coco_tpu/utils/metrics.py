@@ -1,37 +1,45 @@
-"""Structured training metrics: JSONL always, TensorBoard when available.
+"""Training metrics: thin compat shim over the obs event sink.
 
-Replaces the reference's observability stack (SURVEY.md §5.5): Keras progbar
-per rank + TensorBoard callback + Horovod ``MetricAverageCallback``.  Here
-cross-replica averaging already happened ON DEVICE inside the train step
-(``lax.pmean``, train/step.py), so the logger is a process-0-only sink:
-one JSONL line per log event (machine-readable, the era's TensorBoard
-equivalent for this air-gapped environment) plus optional tf.summary output
-when TensorFlow is importable, plus a human line on stdout.
+This module WAS the whole observability stack (an 89-line process-0 JSONL
+scalar sink, replacing the reference's Keras progbar + TensorBoard
+callbacks, SURVEY.md §5.5).  ISSUE 3 grew that into the ``obs`` subsystem
+(``obs/events.py``: run-header records, counters/gauges, device memory,
+compile events; ``obs/trace.py``: spans on the same clock) and this file
+keeps the old import surface alive: ``MetricLogger`` is now a name for
+``EventSink`` with the historical constructor defaults, so every existing
+caller (train.py, the loop, the pod tests) keeps working while gaining the
+run header, aligned monotonic timestamps, loud NaN passthrough, and
+counted (never silent) metric drops.
+
+New code should import ``EventSink`` / ``split_runs`` from
+``batchai_retinanet_horovod_coco_tpu.obs.events`` directly.
 """
 
 from __future__ import annotations
 
-import json
-import os
-import time
 from typing import Any, Mapping
 
-import jax
-import numpy as np
+from batchai_retinanet_horovod_coco_tpu.obs.events import (
+    EventSink,
+    split_runs,
+)
+
+__all__ = ["MetricLogger", "EventSink", "split_runs", "_scalarize"]
 
 
 def _scalarize(metrics: Mapping[str, Any]) -> dict[str, float]:
-    out = {}
-    for k, v in metrics.items():
-        try:
-            out[k] = float(np.asarray(v))
-        except (TypeError, ValueError):
-            continue
-    return out
+    """Historical signature (dict only).  Semantics match the pre-ISSUE-3
+    version (non-finite values always converted fine; only non-castable
+    values drop) — what changed is that drops are now COUNTED AND NAMED
+    by ``obs.events.scalarize`` and the sink announces non-finite values
+    loudly instead of printing them indistinguishably."""
+    from batchai_retinanet_horovod_coco_tpu.obs.events import scalarize
+
+    return scalarize(metrics)[0]
 
 
-class MetricLogger:
-    """Process-0 metric sink: JSONL file + stdout + optional TensorBoard."""
+class MetricLogger(EventSink):
+    """The historical process-0 sink name; see module docstring."""
 
     def __init__(
         self,
@@ -39,51 +47,12 @@ class MetricLogger:
         tensorboard: bool = False,
         stdout: bool = True,
         only_process_zero: bool = True,
+        run_config: Mapping[str, Any] | None = None,
     ):
-        self._enabled = (not only_process_zero) or jax.process_index() == 0
-        self._stdout = stdout
-        self._jsonl = None
-        self._tb = None
-        self._t0 = time.time()
-        if not self._enabled:
-            return
-        if log_dir:
-            os.makedirs(log_dir, exist_ok=True)
-            self._jsonl = open(os.path.join(log_dir, "metrics.jsonl"), "a")
-            if tensorboard:
-                try:
-                    import tensorflow as tf  # heavyweight; only on request
-
-                    self._tb = tf.summary.create_file_writer(
-                        os.path.join(log_dir, "tb")
-                    )
-                except ImportError:
-                    self._tb = None
-
-    def log(self, step: int, metrics: Mapping[str, Any], prefix: str = "train") -> None:
-        if not self._enabled:
-            return
-        scalars = _scalarize(metrics)
-        if self._jsonl:
-            rec = {"step": step, "wall_s": round(time.time() - self._t0, 3)}
-            rec.update({f"{prefix}/{k}": v for k, v in scalars.items()})
-            self._jsonl.write(json.dumps(rec) + "\n")
-            self._jsonl.flush()
-        if self._tb is not None:
-            import tensorflow as tf
-
-            with self._tb.as_default():
-                for k, v in scalars.items():
-                    tf.summary.scalar(f"{prefix}/{k}", v, step=step)
-            self._tb.flush()
-        if self._stdout:
-            parts = " ".join(f"{k}={v:.4g}" for k, v in sorted(scalars.items()))
-            print(f"[{prefix} step {step}] {parts}", flush=True)
-
-    def close(self) -> None:
-        if self._jsonl:
-            self._jsonl.close()
-            self._jsonl = None
-        if self._tb is not None:
-            self._tb.close()
-            self._tb = None
+        super().__init__(
+            log_dir,
+            tensorboard=tensorboard,
+            stdout=stdout,
+            only_process_zero=only_process_zero,
+            run_config=run_config,
+        )

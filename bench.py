@@ -66,6 +66,8 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from batchai_retinanet_horovod_coco_tpu.obs import trace as obs_trace
+
 BUCKET = (800, 1344)
 
 # Distinct exit code for "the accelerator is unreachable" (EX_TEMPFAIL):
@@ -338,7 +340,8 @@ def run_bench(
     # AOT-compile once: the executable both runs the loop and reports the
     # XLA-counted FLOPs of the whole step (forward, assignment, losses,
     # backward, update) for the MFU number.
-    compiled = step.lower(state, batch).compile()
+    with obs_trace.span("aot_compile_train", bucket=f"{hw[0]}x{hw[1]}"):
+        compiled = step.lower(state, batch).compile()
     cost = compiled.cost_analysis()
     if isinstance(cost, list):
         cost = cost[0] if cost else None
@@ -361,11 +364,12 @@ def run_bench(
     window_rates = []
     dt_total = 0.0
     for _ in range(2):
-        t0 = time.perf_counter()
-        for _ in range(half):
-            state, metrics = compiled(state, batch)
-        loss = float(metrics["loss"])
-        dt = time.perf_counter() - t0
+        with obs_trace.span("train_window", bucket=f"{hw[0]}x{hw[1]}"):
+            t0 = time.perf_counter()
+            for _ in range(half):
+                state, metrics = compiled(state, batch)
+            loss = float(metrics["loss"])
+            dt = time.perf_counter() - t0
         assert np.isfinite(loss)
         window_rates.append(batch_size * half / dt)
         dt_total += dt
@@ -590,7 +594,8 @@ def run_eval_bucket(
         rng.integers(0, 256, (batch_size, *hw, 3), dtype=np.uint8)
     )
     fn = make_detect_fn(model, hw, DetectConfig())
-    compiled = fn.lower(state, images).compile()
+    with obs_trace.span("aot_compile_detect", bucket=f"{hw[0]}x{hw[1]}"):
+        compiled = fn.lower(state, images).compile()
     det = None
     for _ in range(EVAL_WARMUP_STEPS):
         det = compiled(state, images)
@@ -600,11 +605,12 @@ def run_eval_bucket(
     window_rates = []
     dt_total = 0.0
     for _ in range(2):
-        t0 = time.perf_counter()
-        for _ in range(half):
-            det = compiled(state, images)
-        _sync_scalar(det)
-        dt = time.perf_counter() - t0
+        with obs_trace.span("eval_window", bucket=f"{hw[0]}x{hw[1]}"):
+            t0 = time.perf_counter()
+            for _ in range(half):
+                det = compiled(state, images)
+            _sync_scalar(det)
+            dt = time.perf_counter() - t0
         window_rates.append(batch_size * half / dt)
         dt_total += dt
     ips = batch_size * 2 * half / dt_total
@@ -690,12 +696,14 @@ def _run_e2e_compare(root, model, state, num_images, size, batch) -> dict:
     def eval_pass(pipelined: bool) -> tuple[float, dict]:
         batches = build_pipeline(ds, pipe_cfg, train=False)
         try:
-            t0 = time.perf_counter()
-            metrics = run_coco_eval(
-                state, model, ds, batches, cfg,
-                pipelined=pipelined, detect_fns=detect_fns,
-            )
-            return time.perf_counter() - t0, metrics
+            with obs_trace.span("e2e_eval", pipelined=pipelined):
+                t0 = time.perf_counter()
+                metrics = run_coco_eval(
+                    state, model, ds, batches, cfg,
+                    pipelined=pipelined, detect_fns=detect_fns,
+                )
+                dt = time.perf_counter() - t0
+            return dt, metrics
         finally:
             batches.close()
 
@@ -879,7 +887,24 @@ def main(argv: list[str] | None = None) -> None:
              "path (per-bucket AOT detect + postprocess-only + "
              "sequential-vs-pipelined e2e)",
     )
+    ap.add_argument(
+        "--trace", "--obs-trace", action="store_true", dest="trace",
+        help="record obs trace spans (AOT compiles, timed windows, and "
+             "for --mode eval the full three-stage e2e pipeline) and "
+             "write a Perfetto-loadable Chrome trace artifact per bench "
+             "mode into --obs-dir (--obs-trace is the train.py spelling, "
+             "accepted here too)",
+    )
+    ap.add_argument(
+        "--obs-dir", default="artifacts/obs",
+        help="where --trace writes its trace artifact",
+    )
     args = ap.parse_args(argv)
+
+    if args.trace:
+        obs_trace.configure(
+            args.obs_dir, process_label=f"bench-{args.mode}"
+        )
 
     # Availability probe BEFORE any in-process device work: a dead tunnel
     # can hang backend init, which only a subprocess probe can bound.
@@ -903,6 +928,15 @@ def main(argv: list[str] | None = None) -> None:
                 args.mode, 1, str(e), phase="mid-run"
             ) from None
         raise
+    finally:
+        if args.trace:
+            obs_trace.export()
+            merged = obs_trace.merge_traces(
+                out_name=f"bench_{args.mode}_trace.json"
+            )
+            # "#"-prefixed: the bench's stdout contract is JSON lines plus
+            # comment lines; a consumer parsing first/last JSON is safe.
+            print(f"# trace written to {merged}", flush=True)
 
 
 if __name__ == "__main__":

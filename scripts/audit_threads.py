@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Static watchdog-coverage audit: every thread/process spawn site in the
+package must register with the obs watchdog or say why it doesn't.
+
+The sibling of ``audit_collectives.py`` (which makes the scaling premise
+checkable, this makes the OBSERVABILITY premise checkable): the stall
+watchdog (obs/watchdog.py) only diagnoses components that heartbeat, so a
+new ``threading.Thread``/``mp.Process`` spawned without registering is a
+future "it hung and nothing says why" — exactly the hole ISSUE 3 closes.
+This audit walks the package AST and, for every spawn call, requires one
+of, within ``WINDOW`` lines of the spawn:
+
+- a ``watchdog.register(`` call (registration at the spawn site), or
+- a ``# watchdog:`` / ``# watchdog-exempt:`` comment with a non-empty
+  rationale (e.g. "registers in feeder() at thread start", "workers
+  heartbeat implicitly via the result queue").
+
+Run:
+    python scripts/audit_threads.py            # audit the package, exit 1
+    python scripts/audit_threads.py --json     # machine-readable report
+
+Wired into ``make lint-obs`` and run in tier-1
+(tests/unit/test_obs.py::test_audit_threads_clean).
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import re
+import sys
+
+# Constructors whose call sites spawn (or pool) concurrent execution.
+SPAWN_NAMES = frozenset(
+    {"Thread", "Timer", "Process", "ThreadPoolExecutor", "ProcessPoolExecutor"}
+)
+
+# Lines around the spawn call searched for a registration or a rationale.
+WINDOW = 8
+
+_MARKER_RE = re.compile(r"#\s*watchdog(?:-exempt)?\s*(?:\((?P<scope>[^)]*)\))?:\s*(?P<why>\S.*)")
+_REGISTER_RE = re.compile(r"\bwatchdog\.register\(")
+
+
+def _spawn_calls(tree: ast.AST):
+    """Yield (lineno, callee_name) for every spawn-constructor call."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = None
+        if isinstance(fn, ast.Attribute):
+            name = fn.attr
+        elif isinstance(fn, ast.Name):
+            name = fn.id
+        if name in SPAWN_NAMES:
+            yield node.lineno, name
+
+
+def audit_file(path: str) -> list[dict]:
+    """Violations in one file: spawn sites with neither a nearby
+    ``watchdog.register(`` nor a ``# watchdog...:`` rationale comment."""
+    with open(path) as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [{"path": path, "line": e.lineno or 0,
+                 "callee": "?", "reason": f"unparseable: {e.msg}"}]
+    lines = src.splitlines()
+    violations = []
+    for lineno, callee in _spawn_calls(tree):
+        lo = max(0, lineno - 1 - WINDOW)
+        hi = min(len(lines), lineno + WINDOW)
+        window = "\n".join(lines[lo:hi])
+        if _REGISTER_RE.search(window) or _MARKER_RE.search(window):
+            continue
+        violations.append(
+            {
+                "path": path,
+                "line": lineno,
+                "callee": callee,
+                "reason": (
+                    f"{callee}() spawn without watchdog.register( or a "
+                    "'# watchdog: <why>' rationale within "
+                    f"{WINDOW} lines"
+                ),
+            }
+        )
+    return violations
+
+
+def audit_package(root: str) -> list[dict]:
+    violations = []
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                violations.extend(audit_file(os.path.join(dirpath, fn)))
+    return violations
+
+
+def default_root() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "..",
+        "batchai_retinanet_horovod_coco_tpu",
+    )
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("root", nargs="?", default=None,
+                    help="directory to audit (default: the package)")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+
+    root = args.root or default_root()
+    violations = audit_package(root)
+    if args.json:
+        print(json.dumps({"root": root, "violations": violations}))
+    elif violations:
+        for v in violations:
+            print(f"{v['path']}:{v['line']}: {v['reason']}")
+        print(f"{len(violations)} unwatched spawn site(s)")
+    else:
+        print("audit_threads: every spawn site is watchdog-covered")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

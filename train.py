@@ -68,6 +68,8 @@ from batchai_retinanet_horovod_coco_tpu.models.retinanet import (  # noqa: E402
 from batchai_retinanet_horovod_coco_tpu.utils.cli import (  # noqa: E402
     add_anchor_flags,
     add_data_pipeline_flags,
+    add_obs_flags,
+    configure_obs,
     make_anchor_config,
     make_pipeline_worker_kwargs,
     resolve_anchor_config,
@@ -217,6 +219,10 @@ def build_parser() -> argparse.ArgumentParser:
         g.add_argument("--tensorboard", action="store_true")
         g.add_argument("--profile-dir", default=None,
                        help="write a jax.profiler trace of a few steps here")
+        # --obs-trace / --obs-dir / --obs-stall-timeout: structured trace
+        # spans + stall watchdog across train/data/eval (utils/cli.py —
+        # shared surface, obs/ subsystem).
+        add_obs_flags(g)
         g.add_argument("--debug-nans", action="store_true",
                        help="numerical sanitizer (SURVEY.md 5.2): enable "
                             "jax_debug_nans so the originating op of a "
@@ -358,7 +364,26 @@ def make_datasets(args):
 
 def main(argv=None) -> dict[str, float]:
     args = parse_args(argv)
+    # Observability bring-up precedes everything that spawns threads or
+    # worker processes: the shm decode workers inherit the trace env
+    # contract at spawn, so tracing must be configured before any
+    # pipeline is built.  The finalize runs even when the run dies — the
+    # partial trace (+ the watchdog's stall dump) IS the post-mortem.
+    obs_dir = configure_obs(args, process_label="train")
+    if obs_dir is None:
+        return _run(args)
+    try:
+        return _run(args)
+    finally:
+        from batchai_retinanet_horovod_coco_tpu import obs
 
+        merged = obs.finalize()
+        if merged:
+            print(f"obs: merged Chrome trace at {merged} "
+                  "(load in Perfetto / chrome://tracing)", flush=True)
+
+
+def _run(args) -> dict[str, float]:
     if args.platform != "auto":
         # Must land before any backend initialization.  The CPU path also
         # forces enough virtual host devices for the requested mesh
@@ -742,7 +767,19 @@ def main(argv=None) -> dict[str, float]:
             voc_weighted_average=args.weighted_average,
         )
 
-    logger = MetricLogger(args.log_dir, tensorboard=args.tensorboard)
+    # run_config feeds the JSONL run-header's config digest: two runs in
+    # one log dir are the same experiment iff their digests match.
+    logger = MetricLogger(
+        args.log_dir, tensorboard=args.tensorboard, run_config=vars(args)
+    )
+    if getattr(args, "obs_trace", False) or getattr(args, "obs_dir", None):
+        # The sink outlives every watchdog poll (closed only at process
+        # end), so stall diagnoses land in metrics.jsonl next to the
+        # metrics they interrupt — configure_obs ran before the logger
+        # existed, so the attachment happens here.
+        from batchai_retinanet_horovod_coco_tpu.obs import watchdog
+
+        watchdog.default().sink = logger
 
     if args.eval_only:
         if args.snapshot_path:
@@ -772,7 +809,8 @@ def main(argv=None) -> dict[str, float]:
         ),
         train=True,
     )
-    state = run_training(
+    try:
+        state = run_training(
         model,
         state,
         train_batches,
@@ -799,7 +837,13 @@ def main(argv=None) -> dict[str, float]:
             or (args.dataset_type == "csv" and val_ds is not None))
         else None,
         logger=logger,
-    )
+        )
+    finally:
+        # Deterministic pipeline teardown (previously left to the GC
+        # finalizer): decode workers/threads are reaped HERE, so shm
+        # workers export their trace files BEFORE main()'s obs finalize
+        # merges — a GC-time close would orphan them from trace.json.
+        train_batches.close()
     return {"final_step": float(int(state.step))}
 
 

@@ -20,7 +20,8 @@ CLUSTER = python -m batchai_retinanet_horovod_coco_tpu.launch.cluster
 
 .PHONY: create submit status delete test test-timings smoke bench \
 	bench-check bench-pipeline pipebench pipebench-check evalbench \
-	evalbench-check canaries convergence-full lint-obs
+	evalbench-check servebench servebench-check canaries \
+	convergence-full lint-obs
 
 create:
 	$(CLUSTER) create --name $(NAME) --zone $(ZONE) --accelerator $(ACCEL) $(DRYFLAG)
@@ -58,12 +59,14 @@ bench:
 
 # Regression tripwire: flagship-bucket TRAIN bench vs the committed
 # BUCKETBENCH.json number, THEN the eval/detect fast path vs the committed
-# EVALBENCH.json number — both with the 3% noise band (exit 1 on either
-# regression).  Both modes probe the TPU first and classify a tunnel
+# EVALBENCH.json number, THEN the serve closed loop vs the committed
+# SERVEBENCH.json number — all with the 3% noise band (exit 1 on any
+# regression).  Every mode probes the TPU first and classifies a tunnel
 # outage as ONE structured JSON line + exit 75, never an rc-1 traceback.
 bench-check:
 	BENCH_SWEEP=0 BENCH_CHECK=1 python bench.py
 	BENCH_SWEEP=0 EVALBENCH_E2E=0 BENCH_CHECK=1 python bench.py --mode eval
+	BENCH_SWEEP=0 SERVEBENCH_OVERLOAD=0 BENCH_CHECK=1 python bench.py --mode serve
 
 # Eval/detect fast-path bench (ISSUE 2): per-bucket AOT detect + NMS-only
 # ms/batch + sequential-vs-pipelined end-to-end comparison, one JSON line.
@@ -75,6 +78,17 @@ evalbench:
 
 evalbench-check:
 	BENCH_SWEEP=0 EVALBENCH_E2E=0 BENCH_CHECK=1 python bench.py --mode eval
+
+# Dynamic-batching serve bench (ISSUE 4): per-bucket closed-loop server
+# throughput vs the in-run detect ceiling (vs_ceiling ≥ 0.9 is the chip
+# acceptance bar), request p50/p99, and an overload leg proving bounded
+# queues SHED instead of queueing unboundedly.  servebench-check is the
+# regression tripwire (same floor/device-class policy as bench-check).
+servebench:
+	python bench.py --mode serve
+
+servebench-check:
+	BENCH_SWEEP=0 SERVEBENCH_OVERLOAD=0 BENCH_CHECK=1 python bench.py --mode serve
 
 # All four XLA-partitioner canaries in one shot (VERDICT r5 next-round #5):
 # each asserts its bug's PRESENCE on the current jax/XLA (or skips when the

@@ -245,6 +245,42 @@ def pick_bucket(
     return max(buckets, key=lambda b: b[0] * b[1])
 
 
+def resize_for_bucket(
+    image: np.ndarray,
+    bucket: tuple[int, int],
+    min_side: int,
+    max_side: int,
+) -> tuple[np.ndarray, float]:
+    """Aspect-preserving resize of ONE decoded uint8 HWC image into
+    ``bucket`` — the single source of truth for inference-time geometry,
+    shared by ``load_example`` (train/eval pipeline) and the serve
+    router (serve/router.py), so a served image can never be resized
+    differently from the eval pipeline that pinned the model's metrics.
+
+    Applies the reference resize rule (``resize_scale``) capped so the
+    result fits the bucket (extreme aspect ratios).  Returns
+    ``(image, scale)``; when no resize is needed the input array is
+    returned as-is and boxes must NOT be rescaled (callers key off the
+    shape changing, matching the historical behavior bit-for-bit).
+    """
+    h, w = image.shape[:2]
+    bh, bw = bucket
+    scale = min(resize_scale(h, w, min_side, max_side), bh / h, bw / w)
+    nh = min(bh, int(round(h * scale)))
+    nw = min(bw, int(round(w * scale)))
+    if (nh, nw) != (h, w):
+        if cv2 is not None:  # ~3x PIL for bilinear resize; releases the GIL
+            image = cv2.resize(image, (nw, nh), interpolation=cv2.INTER_LINEAR)
+        else:
+            from PIL import Image
+
+            image = np.asarray(
+                Image.fromarray(image).resize((nw, nh), Image.BILINEAR),
+                dtype=np.uint8,
+            )
+    return image, scale
+
+
 def load_example(
     dataset: CocoDataset,
     record: ImageRecord,
@@ -278,18 +314,10 @@ def load_example(
         boxes[:, 0] = w - boxes[:, 2]
         boxes[:, 2] = w - x1
 
-    bh, bw = bucket
-    scale = min(resize_scale(h, w, config.min_side, config.max_side), bh / h, bw / w)
-    nh = min(bh, int(round(h * scale)))
-    nw = min(bw, int(round(w * scale)))
-    if (nh, nw) != (h, w):
-        if cv2 is not None:  # ~3x PIL for bilinear resize; releases the GIL
-            image = cv2.resize(image, (nw, nh), interpolation=cv2.INTER_LINEAR)
-        else:
-            image = np.asarray(
-                Image.fromarray(image).resize((nw, nh), Image.BILINEAR),
-                dtype=np.uint8,
-            )
+    image, scale = resize_for_bucket(
+        image, bucket, config.min_side, config.max_side
+    )
+    if image.shape[:2] != (h, w):
         boxes = boxes * scale
     if config.host_normalize:
         image = image.astype(np.float32)

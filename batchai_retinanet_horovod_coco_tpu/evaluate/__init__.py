@@ -21,6 +21,7 @@ from batchai_retinanet_horovod_coco_tpu.evaluate.detect import (
     DetectConfig,
     collect_detections,
     coco_gt_from_dataset,
+    compile_detect_fn,
     detections_to_coco,
     make_detect_fn,
     make_detect_fn_spatial,
@@ -34,6 +35,7 @@ __all__ = [
     "StreamingCocoEval",
     "coco_gt_from_dataset",
     "collect_detections",
+    "compile_detect_fn",
     "compute_ap",
     "evaluate_detections_voc",
     "detections_to_coco",

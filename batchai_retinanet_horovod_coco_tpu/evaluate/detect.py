@@ -132,6 +132,43 @@ def make_detect_fn(
     return jax.jit(sharded)
 
 
+def compile_detect_fn(
+    model,
+    state,
+    image_hw: tuple[int, int],
+    batch_size: int,
+    config: DetectConfig = DetectConfig(),
+    mesh: Mesh | None = None,
+    input_dtype: Any = None,
+) -> Callable[[jnp.ndarray], nms_lib.Detections]:
+    """AOT-lower + compile ONE bucket's detect program at a fixed batch
+    size; returns ``call(images) -> Detections`` with ``state`` closed over.
+
+    The shared load/dispatch path of the eval bench (bench.py --mode eval)
+    and the serve engine (serve/engine.py): both need every
+    (bucket, batch-size) executable built BEFORE traffic arrives, with the
+    multi-second compile attributed by a trace span instead of hiding
+    inside the first dispatch.  Inputs default to uint8 — the raw pipeline
+    format; normalization runs inside the program (``_detect_body``).
+    """
+    fn = make_detect_fn(model, image_hw, config, mesh=mesh)
+    spec = jax.ShapeDtypeStruct(
+        (batch_size, *image_hw, 3),
+        jnp.uint8 if input_dtype is None else input_dtype,
+    )
+    with trace.span(
+        "aot_compile_detect",
+        bucket=f"{image_hw[0]}x{image_hw[1]}",
+        batch=batch_size,
+    ):
+        compiled = fn.lower(state, spec).compile()
+
+    def call(images: jnp.ndarray) -> nms_lib.Detections:
+        return compiled(state, images)
+
+    return call
+
+
 def make_detect_fn_spatial(
     model,
     image_hw: tuple[int, int],

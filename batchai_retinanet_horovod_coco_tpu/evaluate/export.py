@@ -76,29 +76,43 @@ def export_model(
     model,
     output_dir: str,
     buckets: tuple[tuple[int, int], ...],
-    batch_size: int,
+    batch_size: int | tuple[int, ...] = 1,
     config: DetectConfig = DetectConfig(),
     platforms: tuple[str, ...] | None = None,
     class_names: list[str] | None = None,
     label_to_cat_id: dict[int, int] | None = None,
+    image_min_side: int | None = None,
+    image_max_side: int | None = None,
 ) -> str:
-    """Export one detection artifact per shape bucket + a manifest.
+    """Export one detection artifact per (shape bucket, batch size) + a
+    manifest.
 
-    Returns the manifest path.
+    ``batch_size`` may be a tuple — the serve-side dynamic batcher
+    (serve/) pads a partial batch up to the SMALLEST exported size that
+    fits it, so exporting e.g. ``(1, 8)`` lets a lone straggler request
+    run at batch 1 instead of paying a full 8-wide pad.  ``image_min_side``
+    / ``image_max_side`` record the resize rule the model was evaluated
+    under: a server routing raw images into buckets must use them, not its
+    own defaults (manifest-driven routing, same discipline as the anchor
+    config).  Returns the manifest path.
     """
     os.makedirs(output_dir, exist_ok=True)
+    batch_sizes = (
+        (batch_size,) if isinstance(batch_size, int) else tuple(batch_size)
+    )
     entries = []
     for hw in buckets:
-        name = _artifact_name(hw, batch_size)
-        data = export_detector(
-            state, model, hw, batch_size, config, platforms=platforms
-        )
-        with open(os.path.join(output_dir, name), "wb") as f:
-            f.write(data)
-        entries.append(
-            {"file": name, "height": hw[0], "width": hw[1],
-             "batch_size": batch_size}
-        )
+        for b in batch_sizes:
+            name = _artifact_name(hw, b)
+            data = export_detector(
+                state, model, hw, b, config, platforms=platforms
+            )
+            with open(os.path.join(output_dir, name), "wb") as f:
+                f.write(data)
+            entries.append(
+                {"file": name, "height": hw[0], "width": hw[1],
+                 "batch_size": b}
+            )
     manifest = {
         "format": "jax.export.stablehlo.v1",
         "input": "uint8 RGB (B, H, W, 3), raw pixels (normalization inside)",
@@ -114,6 +128,11 @@ def export_model(
         # the artifact is self-describing (a consumer regenerating anchors,
         # e.g. for target assignment, must use these, not the defaults).
         "anchor_config": dataclasses.asdict(config.anchor),
+        # Inference-time resize rule (serve routing): raw images are
+        # resized/bucketed with THESE sides, exactly as the eval pipeline
+        # that produced the model's metrics did.  None on legacy exports.
+        "image_min_side": image_min_side,
+        "image_max_side": image_max_side,
         "class_names": class_names,
         "label_to_cat_id": (
             {str(k): v for k, v in label_to_cat_id.items()}
@@ -136,6 +155,29 @@ class LoadedDetector:
 
     def buckets(self) -> list[tuple[int, int, int]]:
         return sorted(self._fns)
+
+    def bucket_shapes(self) -> list[tuple[int, int]]:
+        """The distinct (H, W) buckets across all exported batch sizes."""
+        return sorted({(h, w) for _b, h, w in self._fns})
+
+    def batch_sizes(self, hw: tuple[int, int]) -> list[int]:
+        """Exported batch sizes for one (H, W) bucket, ascending."""
+        return sorted(b for b, h, w in self._fns if (h, w) == hw)
+
+    def fn(self, batch_size: int, hw: tuple[int, int]):
+        """The raw callable for one exact (batch, H, W) program."""
+        return self._fns[(batch_size, *hw)]
+
+    def warmup(self) -> None:
+        """Run every exported program once on zeros so the deserialized
+        executables are loaded/autotuned before real traffic (the serve
+        engine's startup AOT warm)."""
+        import jax
+
+        for b, h, w in self.buckets():
+            jax.block_until_ready(
+                self._fns[(b, h, w)](np.zeros((b, h, w, 3), np.uint8))
+            )
 
     def __call__(self, images: np.ndarray):
         """Run the artifact matching ``images.shape`` exactly."""

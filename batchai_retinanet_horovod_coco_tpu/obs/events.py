@@ -262,6 +262,33 @@ class EventSink:
             rec["step"] = step
         self._write(rec)
 
+    def histogram(
+        self, name: str, values_ms, step: int | None = None
+    ) -> None:
+        """One latency-distribution record: p50/p90/p99/max over a window
+        of millisecond samples (the serve frontend's per-window request
+        latencies; any bounded sample list works).  Quantiles are computed
+        here — the sink is the cold path — so callers just hand over the
+        raw window."""
+        if not self._enabled or not self._jsonl:
+            return
+        arr = np.asarray(list(values_ms), dtype=np.float64)
+        if arr.size == 0:
+            return
+        rec = {
+            "event": "histogram",
+            "wall_s": round(trace.monotonic_s() - self._t0, 3),
+            "name": name,
+            "count": int(arr.size),
+            "p50_ms": round(float(np.percentile(arr, 50)), 3),
+            "p90_ms": round(float(np.percentile(arr, 90)), 3),
+            "p99_ms": round(float(np.percentile(arr, 99)), 3),
+            "max_ms": round(float(arr.max()), 3),
+        }
+        if step is not None:
+            rec["step"] = step
+        self._write(rec)
+
     def log_device_memory(self, step: int | None = None) -> None:
         """Per-device memory occupancy via ``memory_stats()`` (TPU/GPU
         backends; CPU returns nothing and this is a silent no-op)."""

@@ -1,0 +1,49 @@
+"""Dynamic-batching inference server over exported detectors (ISSUE 4).
+
+The consumer of ``evaluate/export.py``'s StableHLO artifacts (and of live
+params via the same compiled-detect path): requests are decoded/resized on
+host worker threads with the input pipeline's own geometry, routed into
+per-bucket queues, coalesced into padded batches under a max-latency
+deadline, dispatched one-behind on device, and de-padded back to
+per-request COCO-style detections that are bit-identical to
+``run_coco_eval``'s (PARITY.md).
+
+Layers (one module each; RUNBOOK §10 is the operator guide):
+
+- ``common``   — config, request/future lifecycle, error taxonomy, stats
+- ``engine``   — (bucket, batch) executable table + one-behind dispatcher
+- ``router``   — host preprocess workers (decode → resize → bucket-route)
+- ``batcher``  — per-bucket coalescing under the latency deadline
+- ``frontend`` — ``DetectionServer`` (admission/shedding/drain), the
+  stdlib HTTP frontend, and the ``python -m …serve`` CLI
+"""
+
+from batchai_retinanet_horovod_coco_tpu.serve.common import (
+    DetectionFuture,
+    LatencyStats,
+    RequestRejected,
+    RequestTimeout,
+    ServeConfig,
+    ServeError,
+    ServerClosed,
+    ServerError,
+)
+from batchai_retinanet_horovod_coco_tpu.serve.engine import DetectEngine
+from batchai_retinanet_horovod_coco_tpu.serve.frontend import (
+    DetectionServer,
+    serve_http,
+)
+
+__all__ = [
+    "DetectEngine",
+    "DetectionServer",
+    "DetectionFuture",
+    "LatencyStats",
+    "RequestRejected",
+    "RequestTimeout",
+    "ServeConfig",
+    "ServeError",
+    "ServerClosed",
+    "ServerError",
+    "serve_http",
+]

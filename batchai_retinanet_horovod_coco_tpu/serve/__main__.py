@@ -1,0 +1,6 @@
+"""``python -m batchai_retinanet_horovod_coco_tpu.serve`` → the serve CLI."""
+
+from batchai_retinanet_horovod_coco_tpu.serve.frontend import main
+
+if __name__ == "__main__":
+    main()

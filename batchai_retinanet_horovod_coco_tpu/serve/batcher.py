@@ -1,0 +1,197 @@
+"""Per-bucket dynamic batcher: coalesce under a max-latency deadline.
+
+One ``BucketBatcher`` thread per shape bucket pulls preprocessed requests
+from its bounded queue and coalesces them into padded device-ready
+batches:
+
+- a batch FIRES when it reaches the bucket's largest compiled batch size,
+  or when ``max_delay_ms`` has elapsed since its FIRST request arrived —
+  the classic dynamic-batching deadline: under saturation batches fill
+  instantly and the deadline never fires; under light load a lone request
+  waits at most one deadline before running (padded, or at a smaller
+  exported batch size when the engine has one);
+- expired requests (per-request deadline) are rejected at collection time
+  and never occupy a batch row;
+- assembly reuses the input pipeline's pad template (`_pad_template`) and
+  row layout (image at the top-left corner, dataset-mean pad margins) so
+  a served image's batch row is byte-identical to the row the eval
+  pipeline's ``_assemble`` would build — the other half of the
+  bit-identity contract (router docstring has the resize half);
+- the handoff to the dispatcher is a bounded stop-gated put: a slow
+  device backpressures the batcher (watchdog ``idle()``, not a stall),
+  and queue bounds upstream convert sustained overload into sheds.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable
+
+import numpy as np
+
+from batchai_retinanet_horovod_coco_tpu.data.pipeline import (
+    _pad_template,
+    stop_gated_put,
+)
+from batchai_retinanet_horovod_coco_tpu.obs import trace, watchdog
+from batchai_retinanet_horovod_coco_tpu.obs.trace import monotonic_s
+from batchai_retinanet_horovod_coco_tpu.serve.common import (
+    AssembledBatch,
+    RequestTimeout,
+    ServeRequest,
+    ServerClosed,
+)
+
+
+def assemble_requests(
+    requests: list[ServeRequest],
+    hw: tuple[int, int],
+    batch_size: int,
+) -> AssembledBatch:
+    """Pad ≤``batch_size`` preprocessed requests into one device batch.
+
+    Row layout matches ``data/pipeline._assemble`` exactly; surplus rows
+    are whole pad-template slots with ``valid=False`` (the eval pipeline's
+    short-batch semantics — discarded after NMS, invisible in results).
+    """
+    bh, bw = hw
+    pad = _pad_template(bh, bw)
+    images = np.empty((batch_size, bh, bw, 3), dtype=np.uint8)
+    scales = np.ones((batch_size,), dtype=np.float32)
+    valid = np.zeros((batch_size,), dtype=bool)
+    for i, req in enumerate(requests):
+        img = req.image
+        h, w = img.shape[:2]
+        images[i, :h, :w] = img
+        if h < bh:
+            images[i, h:] = pad[h:]
+        if w < bw:
+            images[i, :h, w:] = pad[:h, w:]
+        scales[i] = req.scale
+        valid[i] = True
+    for i in range(len(requests), batch_size):
+        images[i] = pad
+    return AssembledBatch(
+        hw=hw,
+        images=images,
+        requests=list(requests),
+        scales=scales,
+        valid=valid,
+        t_assembled=monotonic_s(),
+    )
+
+
+class BucketBatcher:
+    """One bucket's coalescing thread."""
+
+    _POLL_S = 0.05
+
+    def __init__(
+        self,
+        hw: tuple[int, int],
+        engine,
+        in_queue: queue.Queue,
+        dispatch_queue: queue.Queue,
+        max_delay_ms: float,
+        on_reject: Callable[[ServeRequest, BaseException], None],
+        on_fatal: Callable[[BaseException], None],
+        stop: threading.Event,
+    ):
+        self.hw = hw
+        self._engine = engine
+        self._in = in_queue
+        self._out = dispatch_queue
+        self._max_delay_s = max(0.0, max_delay_ms) / 1e3
+        self._on_reject = on_reject
+        self._on_fatal = on_fatal
+        self._stop = stop
+        self.batches = 0
+        self.deadline_fires = 0
+        # watchdog: registers in _run() at thread start.
+        self.thread = threading.Thread(
+            target=self._run,
+            daemon=True,
+            name=f"serve-batcher-{hw[0]}x{hw[1]}",
+        )
+        self.thread.start()
+
+    def _take_live(self, timeout: float) -> ServeRequest | None:
+        """Next non-expired request within ``timeout`` (expired ones are
+        rejected in passing), else None."""
+        deadline = monotonic_s() + timeout
+        while True:
+            remaining = deadline - monotonic_s()
+            if remaining <= 0:
+                return None
+            try:
+                req = self._in.get(timeout=min(remaining, self._POLL_S))
+            except queue.Empty:
+                if self._stop.is_set():
+                    return None
+                continue
+            if req.expired():
+                self._on_reject(req, RequestTimeout(
+                    f"request {req.id} expired waiting for a batch"
+                ))
+                continue
+            return req
+
+    def _collect(self) -> list[ServeRequest] | None:
+        """Block for a first request, then coalesce until full or the
+        max-latency deadline; None when stopping with nothing taken."""
+        first = None
+        while first is None:
+            if self._stop.is_set():
+                return None
+            first = self._take_live(self._POLL_S)
+            self._hb.beat()
+        max_b = self._engine.max_batch(self.hw)
+        batch = [first]
+        fire_at = monotonic_s() + self._max_delay_s
+        while len(batch) < max_b:
+            remaining = fire_at - monotonic_s()
+            if remaining <= 0 or self._stop.is_set():
+                self.deadline_fires += 1
+                break
+            req = self._take_live(remaining)
+            if req is not None:
+                batch.append(req)
+        return batch
+
+    def _run(self) -> None:
+        self._hb = watchdog.register(
+            f"serve-batcher-{self.hw[0]}x{self.hw[1]}",
+            details=lambda: {
+                "qsize": self._in.qsize(),
+                "batches": self.batches,
+            },
+        )
+        hb = self._hb
+        try:
+            while not self._stop.is_set():
+                hb.beat()
+                batch = self._collect()
+                if not batch:
+                    continue
+                bsize = self._engine.batch_size_for(self.hw, len(batch))
+                with trace.span(
+                    "serve_assemble",
+                    bucket=f"{self.hw[0]}x{self.hw[1]}",
+                    n=len(batch),
+                    padded_to=bsize,
+                ):
+                    assembled = assemble_requests(batch, self.hw, bsize)
+                self.batches += 1
+                hb.idle()  # a full dispatch queue is device backpressure
+                if not stop_gated_put(self._out, assembled, self._stop):
+                    for req in batch:
+                        self._on_reject(
+                            req, ServerClosed("server closed mid-batch")
+                        )
+                    return
+                hb.beat()
+        except BaseException as exc:
+            self._on_fatal(exc)
+        finally:
+            hb.close()

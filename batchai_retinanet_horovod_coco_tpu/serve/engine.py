@@ -1,0 +1,288 @@
+"""Executable table + one-behind device dispatch (the serve device layer).
+
+``DetectEngine`` owns one compiled detection program per (shape bucket,
+batch size) and nothing else — the TVM lesson (PAPERS.md): a compiled
+static-shape program is the deployable unit, and serving is routing into a
+small table of them.  Two constructors:
+
+- ``from_export(dir)`` — load a ``convert_model.py`` export directory
+  (evaluate/export.py): self-contained StableHLO artifacts, params baked
+  in, NO model code needed.  Routing metadata (buckets, batch sizes,
+  resize rule, label→category mapping) comes from the manifest.
+- ``from_state(model, state, ...)`` — live params, AOT-compiled through
+  the same ``evaluate.detect.compile_detect_fn`` path the eval bench
+  uses, so a serve executable can never drift from the benched one.
+
+Both AOT-build every executable at construction and ``warmup()`` runs
+each once on zeros — no request ever pays a compile (SURVEY.md §7.3's
+static-shape price is paid exactly once, at startup).
+
+``DeviceDispatcher`` is the single device-facing thread: it pulls
+assembled batches from a bounded queue and dispatches ONE-BEHIND — batch
+N is dispatched before batch N−1's results are pulled, so the host-side
+``device_get`` + conversion of N−1 overlap N's forward+NMS on device (the
+``evaluate/detect.py`` eval-driver overlap trick, request-path edition).
+When the queue runs dry the pending batch is fetched immediately, so the
+overlap never costs latency under light load.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable
+
+import numpy as np
+
+from batchai_retinanet_horovod_coco_tpu.data.pipeline import stop_gated_put
+from batchai_retinanet_horovod_coco_tpu.obs import trace, watchdog
+from batchai_retinanet_horovod_coco_tpu.serve.common import AssembledBatch
+
+
+class IdentityLabelMap(dict):
+    """label → category fallback when no mapping is known (CSV-style
+    datasets where labels ARE the category ids)."""
+
+    def __missing__(self, key: int) -> int:
+        return key
+
+
+class DetectEngine:
+    """A (bucket, batch) → compiled-program table with routing metadata."""
+
+    def __init__(
+        self,
+        fns: dict[tuple[int, int], dict[int, Callable]],
+        min_side: int,
+        max_side: int,
+        label_to_cat_id: dict[int, int] | None = None,
+        source: str = "live",
+    ):
+        if not fns:
+            raise ValueError("engine needs at least one (bucket, batch) program")
+        self._fns = fns
+        self.min_side = min_side
+        self.max_side = max_side
+        self.label_to_cat_id = (
+            label_to_cat_id if label_to_cat_id else IdentityLabelMap()
+        )
+        self.source = source
+        self.buckets: tuple[tuple[int, int], ...] = tuple(sorted(fns))
+
+    # ---- table lookups ---------------------------------------------------
+
+    def batch_sizes(self, hw: tuple[int, int]) -> list[int]:
+        return sorted(self._fns[hw])
+
+    def max_batch(self, hw: tuple[int, int]) -> int:
+        return max(self._fns[hw])
+
+    def batch_size_for(self, hw: tuple[int, int], n: int) -> int:
+        """Smallest compiled batch size that fits ``n`` requests (a lone
+        straggler runs at batch 1 when exported); the largest otherwise —
+        the batcher never forms more than ``max_batch`` requests."""
+        sizes = self.batch_sizes(hw)
+        for b in sizes:
+            if b >= n:
+                return b
+        return sizes[-1]
+
+    # ---- device ----------------------------------------------------------
+
+    def dispatch(self, hw: tuple[int, int], images: np.ndarray):
+        """Asynchronously dispatch one padded batch; returns device
+        Detections (fetch with ``fetch``)."""
+        return self._fns[hw][images.shape[0]](images)
+
+    def fetch(self, det):
+        """Block until a dispatched batch finishes; numpy Detections."""
+        import jax
+
+        return jax.device_get(det)
+
+    def warmup(self) -> None:
+        """Run every (bucket, batch) program once on zeros and sync — the
+        startup AOT warm that keeps compiles/deserialization-autotune out
+        of the request path."""
+        import jax
+
+        for hw in self.buckets:
+            for b in self.batch_sizes(hw):
+                with trace.span(
+                    "serve_warmup", bucket=f"{hw[0]}x{hw[1]}", batch=b
+                ):
+                    jax.block_until_ready(
+                        self.dispatch(hw, np.zeros((b, *hw, 3), np.uint8))
+                    )
+
+    # ---- constructors ----------------------------------------------------
+
+    @classmethod
+    def from_export(cls, export_dir: str) -> "DetectEngine":
+        """Engine over a ``convert_model.py`` export directory — needs only
+        jax, never the model code or the checkpoint."""
+        from batchai_retinanet_horovod_coco_tpu.evaluate.export import (
+            load_model,
+        )
+
+        from batchai_retinanet_horovod_coco_tpu.ops.nms import Detections
+
+        loaded = load_model(export_dir)
+        fns: dict[tuple[int, int], dict[int, Callable]] = {}
+        for b, h, w in loaded.buckets():
+            raw = loaded.fn(b, (h, w))
+
+            # Exported programs return a bare (boxes, scores, labels,
+            # valid) tuple (jax.export flattens the NamedTuple); restore
+            # the Detections view the conversion path expects.
+            def call(images, _raw=raw):
+                return Detections(*_raw(images))
+
+            fns.setdefault((h, w), {})[b] = call
+        manifest = loaded.manifest
+        raw_map = manifest.get("label_to_cat_id")
+        label_map = (
+            {int(k): int(v) for k, v in raw_map.items()} if raw_map else None
+        )
+        buckets = sorted(fns)
+        # Legacy manifests predate the recorded resize rule; falling back
+        # to the bucket extents keeps routing sane (every image fits SOME
+        # bucket) while new exports carry the exact eval-time sides.
+        min_side = manifest.get("image_min_side") or min(
+            min(hw) for hw in buckets
+        )
+        max_side = manifest.get("image_max_side") or max(
+            max(hw) for hw in buckets
+        )
+        return cls(fns, min_side, max_side, label_map, source=export_dir)
+
+    @classmethod
+    def from_state(
+        cls,
+        model,
+        state,
+        buckets: tuple[tuple[int, int], ...] | None = None,
+        batch_sizes: tuple[int, ...] = (8,),
+        config=None,
+        min_side: int = 800,
+        max_side: int = 1333,
+        label_to_cat_id: dict[int, int] | None = None,
+        mesh=None,
+    ) -> "DetectEngine":
+        """Engine over live params, AOT-compiled via the shared
+        ``compile_detect_fn`` path (one executable per bucket × batch)."""
+        from batchai_retinanet_horovod_coco_tpu.data.pipeline import (
+            default_buckets,
+        )
+        from batchai_retinanet_horovod_coco_tpu.evaluate.detect import (
+            DetectConfig,
+            compile_detect_fn,
+        )
+
+        if buckets is None:
+            buckets = default_buckets(min_side, max_side)
+        if config is None:
+            config = DetectConfig()
+        fns: dict[tuple[int, int], dict[int, Callable]] = {}
+        for hw in buckets:
+            fns[hw] = {
+                b: compile_detect_fn(model, state, hw, b, config, mesh=mesh)
+                for b in sorted(set(batch_sizes))
+            }
+        return cls(fns, min_side, max_side, label_to_cat_id, source="live")
+
+
+class DeviceDispatcher:
+    """The single device thread: bounded in-queue → one-behind dispatch.
+
+    ``on_batch(assembled, detections_np)`` runs HERE, after batch N+1 has
+    been dispatched (or immediately when the queue is idle) — conversion
+    and future-fulfillment overlap device compute exactly as the eval
+    driver's fetch-convert of batch N−1 overlaps batch N's NMS.
+    ``on_fatal(exc)`` routes a crash to the frontend (shm error contract).
+    """
+
+    _POLL_S = 0.05
+
+    def __init__(
+        self,
+        engine: DetectEngine,
+        batch_queue: queue.Queue,
+        on_batch: Callable[[AssembledBatch, object], None],
+        on_fatal: Callable[[BaseException], None],
+        stop: threading.Event,
+    ):
+        self._engine = engine
+        self._queue = batch_queue
+        self._on_batch = on_batch
+        self._on_fatal = on_fatal
+        self._stop = stop
+        self.dispatched_batches = 0
+        # watchdog: registers in _run() at thread start.
+        self.thread = threading.Thread(
+            target=self._run, daemon=True, name="serve-dispatch"
+        )
+        self.thread.start()
+
+    def _finish(self, pending) -> None:
+        assembled, det = pending
+        with trace.span(
+            "serve_fetch", bucket=f"{assembled.hw[0]}x{assembled.hw[1]}"
+        ):
+            fetched = self._engine.fetch(det)
+        self._on_batch(assembled, fetched)
+
+    def _run(self) -> None:
+        # Beats on every poll (an idle dispatcher is healthy); a wedged
+        # device_get — the canonical dead-device-stream hang — stops the
+        # heartbeat, which is exactly what the watchdog exists to name.
+        hb = watchdog.register(
+            "serve-dispatch",
+            details=lambda: {
+                "qsize": self._queue.qsize(),
+                "dispatched": self.dispatched_batches,
+            },
+        )
+        pending = None
+        try:
+            while True:
+                hb.beat()
+                if self._stop.is_set():
+                    return
+                try:
+                    assembled = self._queue.get(timeout=self._POLL_S)
+                except queue.Empty:
+                    # Idle: flush the one-behind batch now so overlap
+                    # never costs latency when no next batch exists.
+                    if pending is not None:
+                        self._finish(pending)
+                        pending = None
+                    continue
+                with trace.span(
+                    "serve_dispatch",
+                    bucket=f"{assembled.hw[0]}x{assembled.hw[1]}",
+                    n=len(assembled.requests),
+                ):
+                    det = self._engine.dispatch(assembled.hw, assembled.images)
+                self.dispatched_batches += 1
+                if trace.enabled():
+                    trace.counter("serve.dispatch_qsize", self._queue.qsize())
+                if pending is not None:
+                    self._finish(pending)
+                pending = (assembled, det)
+        except BaseException as exc:
+            self._on_fatal(exc)
+        finally:
+            # A pending batch at exit needs no flush: the clean close path
+            # (frontend drain) waits for in-flight == 0 BEFORE setting
+            # stop (the idle-flush above fetched it), and the abort/crash
+            # paths reject every outstanding future at the frontend.
+            hb.close()
+
+
+__all__ = [
+    "DetectEngine",
+    "DeviceDispatcher",
+    "IdentityLabelMap",
+    "stop_gated_put",
+]

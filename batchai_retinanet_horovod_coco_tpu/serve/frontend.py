@@ -1,0 +1,550 @@
+"""The serve frontend: admission control, request futures, stats, drain.
+
+``DetectionServer`` wires the four serve machines together::
+
+    submit(image) ──► admission queue (bounded; full ⇒ shed)
+                        │  router workers: decode → resize → bucket
+                        ▼
+    per-bucket queues (bounded; full ⇒ shed)
+                        │  BucketBatcher: coalesce under max_delay_ms
+                        ▼
+    dispatch queue (bounded; full ⇒ backpressure)
+                        │  DeviceDispatcher: one-behind device dispatch
+                        ▼
+    fetch → detections_to_coco → per-request futures fulfilled
+
+Contracts (pinned by tests/unit/test_serve.py):
+
+- **Bit-identity**: a served image's detections are byte-for-byte the
+  dicts ``run_coco_eval``'s sequential ``collect_detections`` produces
+  for the same image — same resize (router), same batch row layout
+  (batcher), same compiled program family (engine), same conversion
+  (``detections_to_coco``, shared, not reimplemented).
+- **Load shedding**: every queue is bounded; overload surfaces as
+  ``RequestRejected(reason)`` at ``submit()`` or on the future — p99 of
+  ACCEPTED requests stays bounded instead of the queue growing without
+  limit.
+- **Error propagation**: a crash in any serve thread fails every
+  outstanding future with ``ServerError`` (original exception chained)
+  and re-raises at the next ``submit()``/``result()`` — the shm
+  pipeline's crash-re-raises-in-driver contract.
+- **Graceful drain**: ``close()`` stops admission, waits (bounded) for
+  in-flight requests to complete, then stops the threads; ``close()``
+  never hangs and is idempotent.
+- **Observability**: spans per stage (`serve_preprocess`,
+  `serve_assemble`, `serve_dispatch`, `serve_fetch`, `serve_convert`)
+  plus a cross-thread ``serve_request`` span per request; queue-depth
+  counters; a watchdog heartbeat on every serve thread; periodic
+  ``serve_stats`` events (p50/p99, sheds) into the obs event sink.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import queue
+import threading
+from typing import Any
+
+import numpy as np
+
+from batchai_retinanet_horovod_coco_tpu.evaluate.detect import (
+    detections_to_coco,
+)
+from batchai_retinanet_horovod_coco_tpu.obs import trace, watchdog
+from batchai_retinanet_horovod_coco_tpu.obs.trace import monotonic_s
+from batchai_retinanet_horovod_coco_tpu.serve.batcher import BucketBatcher
+from batchai_retinanet_horovod_coco_tpu.serve.common import (
+    AssembledBatch,
+    DetectionFuture,
+    LatencyStats,
+    RequestRejected,
+    RequestTimeout,
+    ServeConfig,
+    ServeRequest,
+    ServerClosed,
+    ServerError,
+)
+from batchai_retinanet_horovod_coco_tpu.serve.engine import (
+    DetectEngine,
+    DeviceDispatcher,
+)
+from batchai_retinanet_horovod_coco_tpu.serve.router import Router
+
+
+class DetectionServer:
+    """Dynamic-batching inference server over a ``DetectEngine``."""
+
+    def __init__(
+        self,
+        engine: DetectEngine,
+        config: ServeConfig = ServeConfig(),
+        sink: Any = None,
+        warmup: bool = True,
+    ):
+        self.engine = engine
+        self.config = config
+        self.sink = sink
+        self.stats = LatencyStats(window=config.latency_window)
+        if warmup:
+            engine.warmup()
+
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._drained = threading.Condition(self._lock)
+        self._outstanding: dict[int, ServeRequest] = {}
+        self._error: BaseException | None = None
+        self._accepting = True
+        self._closed = False
+        self._ids = itertools.count()
+        self._batches_done = 0
+
+        self._admission: queue.Queue = queue.Queue(
+            maxsize=max(1, config.admission_queue)
+        )
+        self._bucket_queues = {
+            hw: queue.Queue(maxsize=max(1, config.bucket_queue))
+            for hw in engine.buckets
+        }
+        self._dispatch_queue: queue.Queue = queue.Queue(
+            maxsize=max(1, config.dispatch_depth)
+        )
+        self._router = Router(
+            engine,
+            self._admission,
+            self._bucket_queues,
+            on_reject=self._reject,
+            on_fatal=self._fail,
+            stop=self._stop,
+            workers=config.preprocess_workers,
+        )
+        self._batchers = [
+            BucketBatcher(
+                hw,
+                engine,
+                self._bucket_queues[hw],
+                self._dispatch_queue,
+                config.max_delay_ms,
+                on_reject=self._reject,
+                on_fatal=self._fail,
+                stop=self._stop,
+            )
+            for hw in engine.buckets
+        ]
+        self._dispatcher = DeviceDispatcher(
+            engine,
+            self._dispatch_queue,
+            on_batch=self._on_batch,
+            on_fatal=self._fail,
+            stop=self._stop,
+        )
+
+    # ---- client surface --------------------------------------------------
+
+    def submit(
+        self,
+        image,
+        timeout_s: float | None = None,
+    ) -> DetectionFuture:
+        """Enqueue one image (HWC uint8 array or encoded bytes); returns a
+        future.  Raises ``RequestRejected`` when shed at admission,
+        ``ServerClosed`` after close, ``ServerError`` after a crash."""
+        self._raise_pending()
+        if timeout_s is None:
+            timeout_s = self.config.default_timeout_s
+        req = ServeRequest(
+            next(self._ids),
+            image,
+            None if timeout_s is None else monotonic_s() + timeout_s,
+        )
+        req.span = trace.begin("serve_request", id=req.id)
+        # The accepting check and the registration must share ONE lock
+        # acquisition: close()/_fail() flip _accepting and then reject
+        # everything registered, so a request registered after a lock-free
+        # check could slip in after the reject sweep and never resolve.
+        with self._lock:
+            if not self._accepting:
+                self.stats.record_shed("shutting_down")
+                trace.end(req.span)
+                raise ServerClosed("server is draining/closed")
+            self._outstanding[req.id] = req
+        try:
+            self._admission.put_nowait(req)
+        except queue.Full:
+            exc = RequestRejected("admission_queue_full")
+            self._reject(req, exc)
+            raise exc from None
+        if trace.enabled():
+            trace.counter("serve.admission_qsize", self._admission.qsize())
+        return req.future
+
+    def detect(self, image, timeout_s: float | None = None) -> list[dict]:
+        """Blocking convenience: ``submit()`` + ``result()``."""
+        return self.submit(image, timeout_s=timeout_s).result()
+
+    def snapshot(self) -> dict:
+        """Stats + live queue depths (the /stats endpoint payload)."""
+        snap = self.stats.snapshot()
+        with self._lock:
+            snap["outstanding"] = len(self._outstanding)
+        snap["admission_qsize"] = self._admission.qsize()
+        snap["bucket_qsize"] = {
+            f"{hw[0]}x{hw[1]}": q.qsize()
+            for hw, q in self._bucket_queues.items()
+        }
+        snap["dispatch_qsize"] = self._dispatch_queue.qsize()
+        snap["batches"] = self._batches_done
+        snap["deadline_fires"] = sum(b.deadline_fires for b in self._batchers)
+        return snap
+
+    def close(self, drain: bool = True, timeout_s: float | None = None) -> None:
+        """Stop accepting, optionally drain in-flight work, stop threads.
+
+        Never hangs: the drain wait is bounded (``config.drain_timeout_s``
+        unless overridden) and leftovers are rejected with
+        ``ServerClosed``; idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._accepting = False
+        if drain and self._error is None:
+            budget = (
+                self.config.drain_timeout_s if timeout_s is None else timeout_s
+            )
+            deadline = monotonic_s() + budget
+            with self._drained:
+                while self._outstanding:
+                    remaining = deadline - monotonic_s()
+                    if remaining <= 0:
+                        break
+                    self._drained.wait(timeout=min(remaining, 0.2))
+        self._stop.set()
+        self._reject_all(ServerClosed("server closed"))
+        for t in (
+            *self._router.threads,
+            *(b.thread for b in self._batchers),
+            self._dispatcher.thread,
+        ):
+            t.join(timeout=10)
+        self._emit_stats(final=True)
+
+    def __enter__(self) -> "DetectionServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close(drain=exc == (None, None, None))
+
+    # ---- completion paths (any serve thread) -----------------------------
+
+    def _finish(self, req: ServeRequest, *, result=None, error=None) -> bool:
+        """Complete one request exactly once (both the fulfill and reject
+        paths funnel here); False if it was already completed."""
+        with self._lock:
+            if self._outstanding.pop(req.id, None) is None:
+                return False
+            self._drained.notify_all()
+        trace.end(req.span)
+        if error is None:
+            self.stats.record(monotonic_s() - req.t_submit)
+            req.future._set_result(result)
+        else:
+            if isinstance(error, RequestRejected):
+                self.stats.record_shed(error.reason)
+            elif isinstance(error, RequestTimeout):
+                self.stats.record_timeout()
+            else:
+                self.stats.record_failure()
+            req.future._set_error(error)
+        return True
+
+    def _reject(self, req: ServeRequest, exc: BaseException) -> None:
+        self._finish(req, error=exc)
+
+    def _reject_all(self, exc: BaseException) -> None:
+        with self._lock:
+            pending = list(self._outstanding.values())
+        for req in pending:
+            self._finish(req, error=exc)
+
+    def _fail(self, exc: BaseException) -> None:
+        """Fatal error in any serve thread: record once, stop everything,
+        fail every outstanding future (shm-pipeline crash contract)."""
+        with self._lock:
+            if self._error is None:
+                self._error = exc
+            self._accepting = False
+        self._stop.set()
+        wrapped = ServerError("serve worker thread crashed")
+        wrapped.__cause__ = exc
+        self._reject_all(wrapped)
+
+    def _raise_pending(self) -> None:
+        if self._error is not None:
+            raise ServerError("serve worker thread crashed") from self._error
+
+    # ---- batch completion (dispatcher thread) ----------------------------
+
+    def _on_batch(self, assembled: AssembledBatch, det) -> None:
+        reqs = assembled.requests
+        n = assembled.images.shape[0]
+        ids = np.full((n,), -1, dtype=np.int64)
+        ids[: len(reqs)] = [r.id for r in reqs]
+        image_sizes = {r.id: r.orig_wh for r in reqs}
+        with trace.span(
+            "serve_convert",
+            bucket=f"{assembled.hw[0]}x{assembled.hw[1]}",
+            n=len(reqs),
+        ):
+            # THE eval-path conversion (rescale to original coords, clamp
+            # to true bounds, drop degenerates) — shared, not cloned.
+            results = detections_to_coco(
+                det,
+                ids,
+                assembled.scales,
+                assembled.valid,
+                self.engine.label_to_cat_id,
+                image_sizes=image_sizes,
+            )
+        by_id: dict[int, list[dict]] = {r.id: [] for r in reqs}
+        for r in results:
+            by_id[r["image_id"]].append(r)
+        for req in reqs:
+            dets = by_id[req.id]
+            for d in dets:
+                d.pop("image_id", None)  # request-scoped; id is transport
+            if req.expired():
+                self._finish(req, error=RequestTimeout(
+                    f"request {req.id} finished after its deadline"
+                ))
+            else:
+                self._finish(req, result=dets)
+        self._batches_done += 1
+        if (
+            self.sink is not None
+            and self._batches_done % max(1, self.config.stats_every_batches)
+            == 0
+        ):
+            self._emit_stats()
+
+    def _emit_stats(self, final: bool = False) -> None:
+        if self.sink is None:
+            return
+        try:
+            self.sink.event(
+                "serve_stats", final=final, **_flatten(self.snapshot())
+            )
+            # The full latency distribution record (p50/p90/p99/max over
+            # the raw window) rides along for richer offline analysis.
+            self.sink.histogram(
+                "serve.request_latency", self.stats.window_ms()
+            )
+        except Exception:
+            pass  # stats must never take the serving path down
+
+
+def _flatten(snap: dict) -> dict:
+    """Nested snapshot → JSONL-friendly flat fields."""
+    out = {}
+    for k, v in snap.items():
+        if isinstance(v, dict):
+            for kk, vv in v.items():
+                out[f"{k}.{kk}"] = vv
+        else:
+            out[k] = v
+    return out
+
+
+# ---- stdlib HTTP frontend ------------------------------------------------
+
+
+def serve_http(
+    server: DetectionServer,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    request_timeout_s: float = 60.0,
+):
+    """Wrap a ``DetectionServer`` in a stdlib ``ThreadingHTTPServer``.
+
+    POST /detect   (body = encoded image)  → 200 JSON detections,
+                   503 + reason on shed, 504 on deadline, 500 on crash
+    GET  /stats    → 200 JSON stats snapshot (also /healthz)
+
+    ``request_timeout_s`` bounds each handler's wait on its future — an
+    HTTP client must never hang on a wedged pipeline (the watchdog names
+    the wedge; the client gets a 504).  Returns the ``http.server``
+    instance; the caller owns ``serve_forever()`` / ``shutdown()`` (the
+    CLI below runs it).
+    """
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def _json(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 (stdlib casing)
+            if self.path in ("/stats", "/healthz"):
+                self._json(200, server.snapshot())
+            else:
+                self._json(404, {"error": "not_found"})
+
+        def do_POST(self):  # noqa: N802
+            if self.path != "/detect":
+                self._json(404, {"error": "not_found"})
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length)
+            try:
+                dets = server.submit(body).result(timeout=request_timeout_s)
+            except RequestRejected as exc:
+                # The taxonomy distinction in status codes: a bad INPUT is
+                # the client's fault and not retryable (400); shed load is
+                # transient and retryable (503).
+                code = 400 if exc.reason == "decode_error" else 503
+                self._json(code, {"error": "rejected", "reason": exc.reason})
+            except (RequestTimeout, TimeoutError):
+                self._json(504, {"error": "deadline_exceeded"})
+            except ServeError as exc:
+                self._json(500, {"error": "server_error", "detail": str(exc)})
+            else:
+                self._json(200, {"detections": dets})
+
+        def log_message(self, *args) -> None:
+            pass  # request logging is the stats/obs layer's job
+
+    return ThreadingHTTPServer((host, port), Handler)
+
+
+# ---- CLI -----------------------------------------------------------------
+
+
+def build_parser():
+    import argparse
+
+    from batchai_retinanet_horovod_coco_tpu.utils.cli import (
+        add_obs_flags,
+        add_serve_flags,
+    )
+
+    p = argparse.ArgumentParser(
+        description="Serve an exported detector (convert_model.py output) "
+                    "over HTTP, or run it over a directory of images.",
+    )
+    p.add_argument("--export-dir", required=True,
+                   help="export directory (manifest.json + .stablehlo "
+                        "artifacts) from convert_model.py")
+    mode = p.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--http", type=int, metavar="PORT",
+                      help="start the HTTP frontend on this port "
+                           "(0 = ephemeral; serves until interrupted)")
+    mode.add_argument("--images", metavar="DIR",
+                      help="offline mode: submit every image in DIR, "
+                           "write detections JSONL, print stats, exit")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--output", default=None,
+                   help="offline mode: detections JSONL path "
+                        "(default: stdout summary only)")
+    p.add_argument("--platform", default="auto",
+                   choices=["auto", "cpu", "tpu"],
+                   help="backend to serve on (same flag surface as "
+                        "convert_model.py / train.py)")
+    add_serve_flags(p)
+    add_obs_flags(p)
+    return p
+
+
+def main(argv: list[str] | None = None) -> dict:
+    import os
+
+    args = build_parser().parse_args(argv)
+
+    if args.platform != "auto":
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    from batchai_retinanet_horovod_coco_tpu.utils.cli import (
+        configure_obs,
+        make_serve_config,
+    )
+
+    obs_dir = configure_obs(args, process_label="serve")
+    engine = DetectEngine.from_export(args.export_dir)
+    print(
+        f"engine: buckets={engine.buckets} "
+        f"batch_sizes={ {hw: engine.batch_sizes(hw) for hw in engine.buckets} } "
+        f"resize={engine.min_side}/{engine.max_side}"
+    )
+    server = DetectionServer(engine, make_serve_config(args))
+    try:
+        if args.images is not None:
+            names = sorted(
+                n for n in os.listdir(args.images)
+                if n.lower().endswith((".jpg", ".jpeg", ".png", ".bmp"))
+            )
+            # The offline client is a polite one: on an admission shed it
+            # BLOCKS on its oldest in-flight future and retries, instead
+            # of crashing — a directory larger than the admission queue
+            # must drain completely, not trip the overload protection.
+            futures: list[tuple[str, object]] = []
+            drained = 0
+            records = []
+
+            def drain_one():
+                nonlocal drained
+                name, fut = futures[drained]
+                drained += 1
+                try:
+                    records.append({"file": name, "detections": fut.result()})
+                except ServeError as exc:
+                    records.append({"file": name, "error": str(exc)})
+
+            for name in names:
+                with open(os.path.join(args.images, name), "rb") as f:
+                    payload = f.read()
+                while True:
+                    try:
+                        futures.append((name, server.submit(payload)))
+                        break
+                    except RequestRejected:
+                        if drained >= len(futures):
+                            raise  # nothing in flight to wait on
+                        drain_one()
+            while drained < len(futures):
+                drain_one()
+            if args.output:
+                with open(args.output, "w") as f:
+                    for rec in records:
+                        f.write(json.dumps(rec) + "\n")
+                print(f"wrote {len(records)} records to {args.output}")
+        else:
+            httpd = serve_http(server, args.host, args.http)
+            print(
+                f"serving on http://{httpd.server_address[0]}:"
+                f"{httpd.server_address[1]} (POST /detect, GET /stats)"
+            )
+            try:
+                httpd.serve_forever()
+            except KeyboardInterrupt:
+                pass
+            finally:
+                httpd.shutdown()
+                httpd.server_close()
+        snap = server.snapshot()
+        print(json.dumps({"serve_stats": snap}))
+        return snap
+    finally:
+        server.close()
+        if obs_dir is not None:
+            from batchai_retinanet_horovod_coco_tpu import obs
+
+            obs.finalize()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,151 @@
+"""Preprocess router: decode → resize → bucket-route, on host worker threads.
+
+The serve twin of the input pipeline's decode stage: raw requests (encoded
+image bytes or decoded HWC uint8 arrays) are decoded and resized on host
+CPU worker threads, then routed into the per-bucket queues the dynamic
+batcher coalesces from.
+
+Geometry is NOT re-implemented here: ``bucket_for_source`` and
+``resize_for_bucket`` (data/pipeline.py) are the single source of truth
+shared with the train/eval pipeline, so a served image lands in exactly
+the bucket — resized to exactly the pixels — that ``run_coco_eval`` would
+have produced for it.  That is what makes the served detections
+bit-identical to the offline eval path (PARITY.md, pinned by
+tests/unit/test_serve.py).
+
+Failure routing is per-request: a bad payload (undecodable bytes, wrong
+dtype/rank) rejects THAT request with ``decode_error`` and the worker
+moves on; only an unexpected crash of the worker loop itself escalates to
+``on_fatal`` (the frontend then fails loudly — shm error contract).
+"""
+
+from __future__ import annotations
+
+import io
+import queue
+import threading
+from typing import Callable
+
+import numpy as np
+
+from batchai_retinanet_horovod_coco_tpu.data.pipeline import (
+    bucket_for_source,
+    resize_for_bucket,
+)
+from batchai_retinanet_horovod_coco_tpu.obs import trace, watchdog
+from batchai_retinanet_horovod_coco_tpu.serve.common import (
+    RequestRejected,
+    RequestTimeout,
+    ServeRequest,
+)
+
+
+def decode_payload(payload) -> np.ndarray:
+    """Request payload → HWC uint8 RGB array (the pipeline's decode
+    contract: ``PIL.Image.open(...).convert("RGB")``, identical to
+    ``load_example``'s, so encoded bytes of a dataset image decode to the
+    same pixels the eval pipeline saw)."""
+    if isinstance(payload, np.ndarray):
+        if payload.ndim != 3 or payload.shape[2] != 3:
+            raise ValueError(f"expected HWC RGB array, got {payload.shape}")
+        if payload.dtype != np.uint8:
+            raise ValueError(f"expected uint8 pixels, got {payload.dtype}")
+        return payload
+    if isinstance(payload, (bytes, bytearray, memoryview)):
+        from PIL import Image
+
+        with Image.open(io.BytesIO(payload)) as im:
+            return np.asarray(im.convert("RGB"), dtype=np.uint8)
+    raise ValueError(f"unsupported payload type {type(payload).__name__}")
+
+
+class Router:
+    """``preprocess_workers`` threads pulling from the admission queue."""
+
+    _POLL_S = 0.1
+
+    def __init__(
+        self,
+        engine,
+        admission_queue: queue.Queue,
+        bucket_queues: dict[tuple[int, int], queue.Queue],
+        on_reject: Callable[[ServeRequest, BaseException], None],
+        on_fatal: Callable[[BaseException], None],
+        stop: threading.Event,
+        workers: int = 2,
+    ):
+        self._engine = engine
+        self._in = admission_queue
+        self._buckets = bucket_queues
+        self._on_reject = on_reject
+        self._on_fatal = on_fatal
+        self._stop = stop
+        # watchdog: each worker registers in _run() at thread start.
+        self.threads = [
+            threading.Thread(
+                target=self._run, daemon=True, name=f"serve-preprocess-{i}"
+            )
+            for i in range(max(1, workers))
+        ]
+        for t in self.threads:
+            t.start()
+
+    def _preprocess(self, req: ServeRequest) -> None:
+        """One request: decode → bucket pick → resize → route (or shed)."""
+        if req.expired():
+            self._on_reject(req, RequestTimeout(
+                f"request {req.id} expired before preprocessing"
+            ))
+            return
+        try:
+            with trace.span("serve_preprocess"):
+                image = decode_payload(req.payload)
+                h, w = image.shape[:2]
+                bucket = bucket_for_source(
+                    h, w, self._engine.min_side, self._engine.max_side,
+                    self._engine.buckets,
+                )
+                resized, scale = resize_for_bucket(
+                    image, bucket, self._engine.min_side,
+                    self._engine.max_side,
+                )
+        except Exception as exc:  # bad input, not a broken server
+            self._on_reject(
+                req, RequestRejected("decode_error", repr(exc))
+            )
+            return
+        req.payload = None  # the raw bytes are dead weight from here on
+        req.image = resized
+        req.scale = np.float32(scale)
+        req.orig_wh = (w, h)
+        req.bucket = bucket
+        q = self._buckets[bucket]
+        try:
+            q.put_nowait(req)  # bounded: full bucket queue = shed, not wait
+        except queue.Full:
+            self._on_reject(req, RequestRejected("bucket_queue_full"))
+            return
+        if trace.enabled():
+            trace.counter(
+                f"serve.bucket_qsize.{bucket[0]}x{bucket[1]}", q.qsize()
+            )
+
+    def _run(self) -> None:
+        # Beats on every poll; only a WEDGED decode/resize stops the
+        # heartbeat (and gets named by the watchdog).
+        hb = watchdog.register(
+            "serve-preprocess",
+            details=lambda: {"admission_qsize": self._in.qsize()},
+        )
+        try:
+            while not self._stop.is_set():
+                hb.beat()
+                try:
+                    req = self._in.get(timeout=self._POLL_S)
+                except queue.Empty:
+                    continue
+                self._preprocess(req)
+        except BaseException as exc:
+            self._on_fatal(exc)
+        finally:
+            hb.close()

@@ -106,6 +106,50 @@ def add_obs_flags(parser) -> None:
                              "is otherwise fully disabled)")
 
 
+def add_serve_flags(parser) -> None:
+    """The inference-server flag surface (serve/frontend.py CLI and
+    ``bench.py --mode serve``; ISSUE 4).  One definition so the bench's
+    load generator and the real server can never drift on knob names."""
+    parser.add_argument("--serve-max-delay-ms", type=float, default=10.0,
+                        help="dynamic-batching deadline: a partial batch "
+                             "fires at most this long after its first "
+                             "request reaches the batcher")
+    parser.add_argument("--serve-admission-queue", type=int, default=128,
+                        help="bounded front-door queue; a full queue "
+                             "REJECTS (sheds) instead of growing — "
+                             "overload becomes explicit 503s, not "
+                             "unbounded latency")
+    parser.add_argument("--serve-bucket-queue", type=int, default=64,
+                        help="bounded per-bucket coalescing queue (full "
+                             "= shed with reason bucket_queue_full)")
+    parser.add_argument("--serve-workers", type=int, default=2,
+                        help="host decode/resize worker threads (the "
+                             "serve router)")
+    parser.add_argument("--serve-timeout-s", type=float, default=None,
+                        help="default per-request deadline (expired "
+                             "requests are rejected, never occupy a "
+                             "batch row); unset = no deadline")
+    parser.add_argument("--serve-drain-timeout-s", type=float, default=30.0,
+                        help="graceful close() waits this long for "
+                             "in-flight requests before rejecting the "
+                             "remainder")
+
+
+def make_serve_config(args):
+    """ServeConfig from the flags above (lazy import: the serve package
+    pulls the data/obs layers, which CLI-only callers may not need)."""
+    from batchai_retinanet_horovod_coco_tpu.serve.common import ServeConfig
+
+    return ServeConfig(
+        max_delay_ms=args.serve_max_delay_ms,
+        admission_queue=args.serve_admission_queue,
+        bucket_queue=args.serve_bucket_queue,
+        preprocess_workers=args.serve_workers,
+        default_timeout_s=args.serve_timeout_s,
+        drain_timeout_s=args.serve_drain_timeout_s,
+    )
+
+
 def configure_obs(args, process_label: str = "main", sink=None):
     """Bring up the obs subsystem from the flags above; returns the obs
     dir (None = disabled).  Call BEFORE building pipelines so spawned shm

@@ -27,6 +27,19 @@ last-known-good rate, labeled as such) and exits with the distinct code
 75 (EX_TEMPFAIL), never a bare rc-1 traceback like ``BENCH_r05.json``.
 Real errors (OOM, shape bugs) still propagate loudly.
 
+``--mode serve`` measures the dynamic-batching inference server (ISSUE 4,
+serve/): per live bucket it AOT-builds the same detect executable the
+server dispatches, measures the in-run sequential detect CEILING on it,
+then drives the server with a saturating closed loop (2×batch client
+threads, steady-state window after a warm period) and reports imgs/s,
+``vs_ceiling`` (the acceptance bar: ≥0.9 on the chip), p50/p99 request
+latency, and an overload leg — an open-loop flood against tiny bounded
+queues that must SHED (reject-with-reason, every accepted request
+resolves, bounded p99) rather than queue unboundedly.  The committed
+record is SERVEBENCH.json; ``make servebench-check`` is the tripwire.
+Knobs: SERVEBENCH_STEPS (window), SERVEBENCH_OVERLOAD=0 (skip the
+overload leg), BENCH_SWEEP=0 (flagship bucket only).
+
 ``vs_baseline``: the reference's own throughput was never recorded
 (BASELINE.json "published": {}, see BASELINE.md), so the ratio is computed
 against the first recorded bench of this rebuild (BENCH_r1.json) when
@@ -158,6 +171,10 @@ def last_known_good(mode: str) -> dict | None:
             with open(_artifact_path("EVALBENCH.json")) as f:
                 data = json.load(f)
             value, source = float(data["value"]), "EVALBENCH.json"
+        elif mode == "serve":
+            with open(_artifact_path("SERVEBENCH.json")) as f:
+                data = json.load(f)
+            value, source = float(data["value"]), "SERVEBENCH.json"
         else:
             with open(_artifact_path("BUCKETBENCH.json")) as f:
                 data = json.load(f)
@@ -191,11 +208,10 @@ def emit_unreachable(
                 "error": "tpu_unreachable",
                 "mode": mode,
                 "phase": phase,  # "probe" | "mid-run"
-                "metric": (
-                    "eval_images_per_sec_per_chip"
-                    if mode == "eval"
-                    else "train_images_per_sec_per_chip"
-                ),
+                "metric": {
+                    "eval": "eval_images_per_sec_per_chip",
+                    "serve": "serve_images_per_sec_per_chip",
+                }.get(mode, "train_images_per_sec_per_chip"),
                 "attempts": attempts,
                 "last_error": str(last_error)[-2000:],
                 "last_known_good": last_known_good(mode),
@@ -586,19 +602,19 @@ def run_eval_bucket(
     policy as the train bench) plus the postprocess-only figure."""
     from batchai_retinanet_horovod_coco_tpu.evaluate.detect import (
         DetectConfig,
-        make_detect_fn,
+        compile_detect_fn,
     )
 
     rng = np.random.default_rng(0)
     images = jnp.asarray(
         rng.integers(0, 256, (batch_size, *hw, 3), dtype=np.uint8)
     )
-    fn = make_detect_fn(model, hw, DetectConfig())
-    with obs_trace.span("aot_compile_detect", bucket=f"{hw[0]}x{hw[1]}"):
-        compiled = fn.lower(state, images).compile()
+    # AOT compile via the ONE shared bench/serve path (the span naming the
+    # compile lives inside compile_detect_fn).
+    call = compile_detect_fn(model, state, hw, batch_size, DetectConfig())
     det = None
     for _ in range(EVAL_WARMUP_STEPS):
-        det = compiled(state, images)
+        det = call(images)
     _sync_scalar(det)
 
     half = max(1, measure_steps // 2)
@@ -608,7 +624,7 @@ def run_eval_bucket(
         with obs_trace.span("eval_window", bucket=f"{hw[0]}x{hw[1]}"):
             t0 = time.perf_counter()
             for _ in range(half):
-                det = compiled(state, images)
+                det = call(images)
             _sync_scalar(det)
             dt = time.perf_counter() - t0
         window_rates.append(batch_size * half / dt)
@@ -804,6 +820,283 @@ def run_eval_mode() -> None:
         raise SystemExit(check_eval_against_committed(value, device_kind))
 
 
+# --- serve mode (ISSUE 4: the dynamic-batching inference server) ----------
+
+# Chip default.  The committed CPU capture shrinks it via SERVEBENCH_STEPS
+# (same policy as EVALBENCH_STEPS).
+SERVE_MEASURE_STEPS = 30
+
+
+def _serve_source_image(hw: tuple[int, int], min_side: int, max_side: int):
+    """A source-resolution image that routes into ``hw`` with a NO-OP
+    resize (min side exactly ``min_side``, max exactly ``max_side``), so
+    the closed loop measures batching+dispatch, not cv2."""
+    h, w = hw
+    if h < w:
+        shape = (min_side, max_side)
+    elif h > w:
+        shape = (max_side, min_side)
+    else:
+        shape = (min_side, min_side)
+    rng = np.random.default_rng(2)
+    return rng.integers(0, 256, (*shape, 3), dtype=np.uint8)
+
+
+def _serve_ceiling(engine, hw, batch_size, steps) -> float:
+    """In-run detect throughput ceiling on the SAME executable the server
+    dispatches (run_eval_bucket's timing pattern: sequential dispatch,
+    one hard sync per window) — the denominator of ``vs_ceiling``."""
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, (batch_size, *hw, 3), dtype=np.uint8)
+    det = engine.dispatch(hw, images)
+    _sync_scalar(engine.fetch(det))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        det = engine.dispatch(hw, images)
+    _sync_scalar(engine.fetch(det))
+    return batch_size * steps / (time.perf_counter() - t0)
+
+
+def _serve_closed_loop(server, img, target: int, clients: int) -> dict:
+    """Saturating closed loop: ``clients`` threads keep one request each
+    in flight until ``target`` requests complete AFTER a one-batch warm
+    period; returns steady-state imgs/s + the server's latency stats."""
+    import threading
+
+    from batchai_retinanet_horovod_coco_tpu.serve import (
+        RequestRejected,
+        ServeError,
+    )
+
+    stop = threading.Event()
+    lock = threading.Lock()
+    state = {"completed": 0, "shed": 0, "t_warm": None, "t_end": None}
+    warm = max(1, clients)
+
+    def client():
+        while not stop.is_set():
+            try:
+                fut = server.submit(img)
+            except RequestRejected:
+                with lock:
+                    state["shed"] += 1
+                continue
+            except ServeError:
+                return
+            try:
+                fut.result(timeout=600)
+            except ServeError:
+                return
+            except TimeoutError:
+                stop.set()
+                return
+            now = time.perf_counter()
+            with lock:
+                state["completed"] += 1
+                if state["completed"] == warm:
+                    state["t_warm"] = now
+                if state["completed"] >= warm + target:
+                    state["t_end"] = now
+                    stop.set()
+
+    t0 = time.perf_counter()
+    # watchdog-exempt: bench client threads, stop-event bounded.
+    threads = [
+        threading.Thread(target=client, daemon=True, name=f"bench-client-{i}")
+        for i in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    # Wake on target-reached OR every-client-dead (a crashed server ends
+    # the clients without setting stop; never sleep out the full hour).
+    while not stop.is_set() and any(t.is_alive() for t in threads):
+        stop.wait(timeout=1.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    t_warm = state["t_warm"] or t0
+    t_end = state["t_end"] or time.perf_counter()
+    measured = max(0, state["completed"] - warm)
+    dt = max(t_end - t_warm, 1e-9)
+    snap = server.snapshot()
+    return {
+        "imgs_per_sec": round(measured / dt, 3),
+        "completed": state["completed"],
+        "closed_loop_shed": state["shed"],
+        "clients": clients,
+        "p50_ms": snap.get("p50_ms"),
+        "p99_ms": snap.get("p99_ms"),
+        "deadline_fires": snap.get("deadline_fires"),
+    }
+
+
+def _serve_overload(engine, hw, batch_size, img) -> dict:
+    """Open-loop flood against tiny bounded queues: the evidence that
+    overload SHEDS (bounded accepted set, bounded p99) instead of
+    queueing unboundedly.  Every accepted request must resolve."""
+    from batchai_retinanet_horovod_coco_tpu.serve import (
+        DetectionServer,
+        RequestRejected,
+        ServeConfig,
+    )
+
+    admission = max(4, batch_size)
+    bucket_q = max(2, batch_size // 2)
+    server = DetectionServer(
+        engine,
+        ServeConfig(
+            max_delay_ms=5.0,
+            admission_queue=admission,
+            bucket_queue=bucket_q,
+            preprocess_workers=1,
+        ),
+        warmup=False,  # the ceiling measurement already warmed it
+    )
+    submissions = 6 * (admission + bucket_q)
+    accepted, shed = [], 0
+    try:
+        for _ in range(submissions):
+            try:
+                accepted.append(server.submit(img))
+            except RequestRejected:
+                shed += 1
+        resolved = sum(1 for f in accepted if f._event.wait(600))
+        snap = server.snapshot()
+    finally:
+        server.close(drain=False)
+    return {
+        "submitted": submissions,
+        "shed_at_submit": shed,
+        "accepted": len(accepted),
+        "resolved": resolved,
+        "completed": snap["completed"],
+        "shed_total": snap["shed_total"],
+        "p99_ms": snap.get("p99_ms"),
+        # The bounded-latency contract: nothing ever queued beyond the
+        # configured bounds, and the flood was shed, not buffered.
+        "sheds_instead_of_queueing": bool(
+            shed > 0 and resolved == len(accepted)
+        ),
+    }
+
+
+def run_serve_bucket(
+    model, state, batch_size: int, hw: tuple[int, int], measure_steps: int,
+    overload: bool,
+) -> dict:
+    from batchai_retinanet_horovod_coco_tpu.evaluate.detect import (
+        DetectConfig,
+    )
+    from batchai_retinanet_horovod_coco_tpu.serve import (
+        DetectEngine,
+        DetectionServer,
+        ServeConfig,
+    )
+
+    min_side, max_side = 800, 1333  # the flagship resize rule behind BUCKET
+    engine = DetectEngine.from_state(
+        model, state, buckets=(hw,), batch_sizes=(batch_size,),
+        config=DetectConfig(), min_side=min_side, max_side=max_side,
+    )
+    engine.warmup()
+    ceiling = _serve_ceiling(
+        engine, hw, batch_size, max(1, measure_steps // 2)
+    )
+    img = _serve_source_image(hw, min_side, max_side)
+    server = DetectionServer(
+        engine,
+        ServeConfig(
+            max_delay_ms=10.0,
+            admission_queue=4 * batch_size,
+            bucket_queue=4 * batch_size,
+            preprocess_workers=2,
+        ),
+        warmup=False,
+    )
+    try:
+        closed = _serve_closed_loop(
+            server, img,
+            target=measure_steps * batch_size,
+            clients=max(2, 2 * batch_size),
+        )
+    finally:
+        server.close(drain=False)
+    out = {
+        "batch": batch_size,
+        "detect_ceiling_imgs_per_sec": round(ceiling, 3),
+        "vs_ceiling": round(closed["imgs_per_sec"] / max(ceiling, 1e-9), 3),
+        **closed,
+    }
+    if overload:
+        with obs_trace.span("serve_overload", bucket=f"{hw[0]}x{hw[1]}"):
+            out["overload"] = _serve_overload(engine, hw, batch_size, img)
+    return out
+
+
+def check_serve_against_committed(value: float, device_kind: str) -> int:
+    """servebench-check: fresh flagship closed-loop SERVE rate vs the
+    committed SERVEBENCH.json — same floor/device policy as bench-check
+    (``_check_floor``)."""
+    try:
+        with open(_artifact_path("SERVEBENCH.json")) as f:
+            committed = json.load(f)
+        committed_value = float(committed["value"])
+    except (OSError, KeyError, ValueError) as e:
+        print(f"# servebench-check: cannot read committed baseline: {e}")
+        return 1
+    return _check_floor(
+        "servebench-check",
+        value,
+        committed_value,
+        str(committed.get("device_kind", "")) or None,
+        device_kind,
+    )
+
+
+def run_serve_mode() -> None:
+    batch_size = int(os.environ.get("BENCH_BATCH", "8"))
+    measure_steps = int(
+        os.environ.get("SERVEBENCH_STEPS", str(SERVE_MEASURE_STEPS))
+    )
+    sweep = os.environ.get("BENCH_SWEEP", "1") not in ("", "0")
+    overload = os.environ.get("SERVEBENCH_OVERLOAD", "1") not in ("", "0")
+    model, state = _eval_model_and_state()
+    device_kind = jax.devices()[0].device_kind
+
+    per_bucket: dict[str, dict] = {}
+    value = None
+    for hw, _share in sweep_buckets():
+        if not sweep and hw != BUCKET:
+            continue
+        try:
+            r = run_serve_bucket(
+                model, state, batch_size, hw, measure_steps, overload
+            )
+        except Exception as e:
+            oom = "RESOURCE_EXHAUSTED" in str(e) or "Out of memory" in str(e)
+            if batch_size <= 2 or not oom:
+                raise
+            print(f"# batch {batch_size} OOM at {hw}; retrying at 2", flush=True)
+            r = run_serve_bucket(model, state, 2, hw, measure_steps, overload)
+        per_bucket[f"{hw[0]}x{hw[1]}"] = r
+        if hw == BUCKET:
+            value = r["imgs_per_sec"]
+
+    out = {
+        "metric": "serve_images_per_sec_per_chip",
+        "mode": "serve",
+        "value": value,
+        "unit": "images/sec/chip",
+        "device_kind": device_kind,
+        "measure_steps": measure_steps,
+        "per_bucket": per_bucket,
+    }
+    print(json.dumps(out), flush=True)
+
+    if os.environ.get("BENCH_CHECK", "") not in ("", "0"):
+        raise SystemExit(check_serve_against_committed(value, device_kind))
+
+
 def run_train_mode() -> None:
     batch_size = int(os.environ.get("BENCH_BATCH", "8"))
     sweep = os.environ.get("BENCH_SWEEP", "1") not in ("", "0")
@@ -882,10 +1175,12 @@ def run_train_mode() -> None:
 def main(argv: list[str] | None = None) -> None:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument(
-        "--mode", choices=("train", "eval"), default="train",
+        "--mode", choices=("train", "eval", "serve"), default="train",
         help="train = flagship SPMD train step; eval = detect/NMS fast "
              "path (per-bucket AOT detect + postprocess-only + "
-             "sequential-vs-pipelined e2e)",
+             "sequential-vs-pipelined e2e); serve = dynamic-batching "
+             "inference server (serve/) under a saturating closed loop "
+             "+ an overload shed leg, vs the in-run detect ceiling",
     )
     ap.add_argument(
         "--trace", "--obs-trace", action="store_true", dest="trace",
@@ -916,6 +1211,8 @@ def main(argv: list[str] | None = None) -> None:
     try:
         if args.mode == "eval":
             run_eval_mode()
+        elif args.mode == "serve":
+            run_serve_mode()
         else:
             run_train_mode()
     except SystemExit:

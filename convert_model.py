@@ -41,7 +41,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "either loads any snapshot)")
     p.add_argument("--f32", action="store_true",
                    help="compute in float32 (default bfloat16)")
-    p.add_argument("--batch-size", type=int, default=1)
+    p.add_argument("--batch-size", type=int, default=None,
+                   help="single exported batch size (shorthand for "
+                        "--batch-sizes N; default 1)")
+    p.add_argument("--batch-sizes", default=None, metavar="B1,B2",
+                   help="comma-separated batch sizes: one artifact per "
+                        "(bucket, batch) — the serve batcher pads a "
+                        "partial batch to the smallest exported size "
+                        "that fits it (serve/engine.py)")
+    p.add_argument("--buckets", default=None, metavar="HxW,HxW",
+                   help="explicit (H, W) shape buckets (e.g. "
+                        "800x1344,1344x800); default: the pipeline's "
+                        "default_buckets for the image sides, i.e. the "
+                        "shapes an eval run actually emits")
     p.add_argument("--image-min-side", type=int, default=800)
     p.add_argument("--image-max-side", type=int, default=1333)
     p.add_argument("--score-threshold", type=float, default=0.05)
@@ -57,6 +69,41 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["auto", "cpu", "tpu"],
                    help="backend to run the export trace on")
     return p
+
+
+def parse_buckets(text: str) -> tuple[tuple[int, int], ...]:
+    """'800x1344,1344x800' → ((800, 1344), (1344, 800))."""
+    buckets = []
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            h, w = part.lower().split("x")
+            buckets.append((int(h), int(w)))
+        except ValueError:
+            raise SystemExit(f"--buckets: not an HxW shape: {part!r}")
+    if not buckets:
+        raise SystemExit("--buckets: empty bucket list")
+    return tuple(buckets)
+
+
+def parse_batch_sizes(args) -> tuple[int, ...]:
+    if args.batch_sizes is not None and args.batch_size is not None:
+        raise SystemExit("pass --batch-size OR --batch-sizes, not both")
+    if args.batch_sizes is not None:
+        try:
+            sizes = tuple(
+                int(v) for v in args.batch_sizes.split(",") if v.strip()
+            )
+        except ValueError:
+            raise SystemExit(
+                f"--batch-sizes: not an int list: {args.batch_sizes!r}"
+            )
+        if not sizes or any(b < 1 for b in sizes):
+            raise SystemExit(f"--batch-sizes: bad sizes {args.batch_sizes!r}")
+        return tuple(sorted(set(sizes)))
+    return (args.batch_size if args.batch_size is not None else 1,)
 
 
 def main(argv: list[str] | None = None) -> str:
@@ -101,7 +148,12 @@ def main(argv: list[str] | None = None) -> str:
             dtype=jnp.float32 if args.f32 else jnp.bfloat16,
         )
     )
-    buckets = default_buckets(args.image_min_side, args.image_max_side)
+    buckets = (
+        parse_buckets(args.buckets)
+        if args.buckets
+        else default_buckets(args.image_min_side, args.image_max_side)
+    )
+    batch_sizes = parse_batch_sizes(args)
     state = create_train_state(
         model, optax.sgd(0.01), (1, *buckets[0], 3), jax.random.key(0)
     )
@@ -121,7 +173,7 @@ def main(argv: list[str] | None = None) -> str:
         model,
         args.output,
         buckets,
-        args.batch_size,
+        batch_sizes,
         DetectConfig(
             score_threshold=args.score_threshold,
             iou_threshold=args.nms_threshold,
@@ -129,6 +181,8 @@ def main(argv: list[str] | None = None) -> str:
             anchor=anchor_config,
         ),
         platforms=platforms,
+        image_min_side=args.image_min_side,
+        image_max_side=args.image_max_side,
     )
     sizes = {
         e: os.path.getsize(os.path.join(args.output, e))

@@ -38,7 +38,7 @@ def fast_probe_env(monkeypatch):
 
 
 class TestUnreachableClassification:
-    @pytest.mark.parametrize("mode", ["train", "eval"])
+    @pytest.mark.parametrize("mode", ["train", "eval", "serve"])
     def test_persistent_unavailable_emits_one_line_and_exit_75(
         self, mode, fast_probe_env, monkeypatch, capsys
     ):
@@ -61,9 +61,10 @@ class TestUnreachableClassification:
         assert lkg is not None
         assert lkg["value"] > 0
         assert "NOT a fresh measurement" in lkg["note"]
-        assert lkg["source"] == (
-            "EVALBENCH.json" if mode == "eval" else "BUCKETBENCH.json"
-        )
+        assert lkg["source"] == {
+            "eval": "EVALBENCH.json",
+            "serve": "SERVEBENCH.json",
+        }.get(mode, "BUCKETBENCH.json")
 
     def test_probe_hang_classified_via_subprocess_timeout(
         self, fast_probe_env, monkeypatch, capsys
@@ -197,3 +198,50 @@ class TestEvalBenchCheck:
         assert bench.check_eval_against_committed(value * 0.95, kind) == 1
         out = capsys.readouterr().out
         assert "ok" in out and "REGRESSION" in out
+
+
+class TestServeBenchCheck:
+    """servebench-check (ISSUE 4): the committed SERVEBENCH.json flagship
+    closed-loop rate minus the noise band is the floor, with the same
+    device-class guard as bench-check/evalbench-check."""
+
+    def _committed(self):
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(bench.__file__)),
+            "SERVEBENCH.json",
+        )
+        with open(path) as f:
+            return json.load(f)
+
+    def test_device_mismatch_passes_with_note(self, capsys):
+        rc = bench.check_serve_against_committed(1.0, "some-future-chip")
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "not comparable" in out
+
+    def test_floor_band_on_matching_device(self, capsys):
+        committed = self._committed()
+        kind = committed["device_kind"]
+        value = float(committed["value"])
+        assert bench.check_serve_against_committed(value * 0.995, kind) == 0
+        assert bench.check_serve_against_committed(value * 0.95, kind) == 1
+        out = capsys.readouterr().out
+        assert "ok" in out and "REGRESSION" in out
+
+    def test_committed_artifact_schema(self):
+        """The committed capture must carry the fields the check and the
+        RUNBOOK read: device_kind, per-bucket ceiling ratio, overload
+        evidence that bounded queues shed."""
+        committed = self._committed()
+        assert committed["metric"] == "serve_images_per_sec_per_chip"
+        assert committed["device_kind"]
+        assert committed["value"] > 0
+        flagship = committed["per_bucket"][
+            f"{bench.BUCKET[0]}x{bench.BUCKET[1]}"
+        ]
+        assert flagship["detect_ceiling_imgs_per_sec"] > 0
+        assert 0 < flagship["vs_ceiling"] <= 1.5
+        overload = flagship["overload"]
+        assert overload["shed_at_submit"] > 0
+        assert overload["resolved"] == overload["accepted"]
+        assert overload["sheds_instead_of_queueing"] is True

@@ -45,6 +45,82 @@ def test_roundtrip_matches_live_detection(tiny_model_and_state, tmp_path):
         )
 
 
+def test_export_multiple_batch_sizes(tiny_model_and_state, tmp_path):
+    """One artifact per (bucket, batch size); the manifest records the
+    inference resize rule for manifest-driven serve routing (ISSUE 4)."""
+    model, state = tiny_model_and_state
+    export_model(
+        state, model, str(tmp_path / "exp"), buckets=((64, 64),),
+        batch_size=(1, 2), config=CONFIG,
+        image_min_side=64, image_max_side=64,
+    )
+    loaded = load_model(str(tmp_path / "exp"))
+    assert loaded.buckets() == [(1, 64, 64), (2, 64, 64)]
+    assert loaded.bucket_shapes() == [(64, 64)]
+    assert loaded.batch_sizes((64, 64)) == [1, 2]
+    assert loaded.manifest["image_min_side"] == 64
+    assert loaded.manifest["image_max_side"] == 64
+    # both programs run; warmup touches every one
+    loaded.warmup()
+    for b in (1, 2):
+        out = loaded(np.zeros((b, 64, 64, 3), dtype=np.uint8))
+        assert np.asarray(out[0]).shape[0] == b
+
+
+_NO_IMPORT_LOADER = """
+import json, os, sys
+import numpy as np
+from jax import export as jax_export
+
+export_dir, in_npz, out_npz = sys.argv[1:4]
+with open(os.path.join(export_dir, "manifest.json")) as f:
+    manifest = json.load(f)
+entry = manifest["artifacts"][0]
+with open(os.path.join(export_dir, entry["file"]), "rb") as f:
+    fn = jax_export.deserialize(f.read()).call
+images = np.load(in_npz)["images"]
+boxes, scores, labels, valid = fn(images)
+np.savez(out_npz, boxes=np.asarray(boxes), scores=np.asarray(scores),
+         labels=np.asarray(labels), valid=np.asarray(valid))
+banned = sorted(m for m in sys.modules if "batchai_retinanet" in m)
+assert not banned, f"model code leaked into the loader: {banned}"
+print("loaded_without_model_code")
+"""
+
+
+def test_artifact_runs_with_no_model_code_imports(
+    tiny_model_and_state, tmp_path
+):
+    """ISSUE 4 satellite: a ``detector_<H>x<W>_b<B>.stablehlo`` artifact
+    is consumable by a process that imports ONLY jax + numpy — no model
+    code, no package import — and its detections are bit-identical to the
+    live ``make_detect_fn`` path."""
+    import subprocess
+    import sys
+
+    model, state = tiny_model_and_state
+    export_model(
+        state, model, str(tmp_path / "exp"), buckets=((64, 64),),
+        batch_size=2, config=CONFIG,
+    )
+    rng = np.random.default_rng(0)
+    images = rng.integers(0, 256, (2, 64, 64, 3), dtype=np.uint8)
+    np.savez(tmp_path / "in.npz", images=images)
+    r = subprocess.run(
+        [sys.executable, "-c", _NO_IMPORT_LOADER, str(tmp_path / "exp"),
+         str(tmp_path / "in.npz"), str(tmp_path / "out.npz")],
+        capture_output=True, text=True, timeout=300,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "loaded_without_model_code" in r.stdout
+
+    got = np.load(tmp_path / "out.npz")
+    want = make_detect_fn(model, (64, 64), CONFIG)(state, images)
+    for name, w in zip(("boxes", "scores", "labels", "valid"), want):
+        np.testing.assert_array_equal(got[name], np.asarray(w), err_msg=name)
+
+
 def test_unknown_shape_rejected(tiny_model_and_state, tmp_path):
     model, state = tiny_model_and_state
     export_model(
@@ -54,6 +130,75 @@ def test_unknown_shape_rejected(tiny_model_and_state, tmp_path):
     loaded = load_model(str(tmp_path / "exp"))
     with pytest.raises(ValueError, match="no exported program"):
         loaded(np.zeros((1, 64, 64, 3), dtype=np.uint8))
+
+
+def test_convert_model_cli_roundtrip_to_server(tmp_path):
+    """ISSUE 4 satellite: checkpoint → ``convert_model.py`` (with bucket /
+    batch-size / platform flags) → export dir → serve engine answers a
+    request.  Fast-tier: the checkpoint is written directly (no training
+    run; the slow CLI test covers train.py in the loop)."""
+    import os
+    import sys
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    import convert_model
+    from batchai_retinanet_horovod_coco_tpu.models import (
+        RetinaNetConfig,
+        build_retinanet,
+    )
+    from batchai_retinanet_horovod_coco_tpu.serve import (
+        DetectEngine,
+        DetectionServer,
+        ServeConfig,
+    )
+    from batchai_retinanet_horovod_coco_tpu.train import create_train_state
+    from batchai_retinanet_horovod_coco_tpu.utils.checkpoint import (
+        CheckpointManager,
+    )
+
+    # Exactly the model convert_model.py rebuilds from these flags.
+    model = build_retinanet(
+        RetinaNetConfig(
+            num_classes=3, backbone="resnet_test", norm_kind="gn",
+            dtype=jnp.float32,
+        )
+    )
+    state = create_train_state(
+        model, optax.sgd(0.01), (1, 64, 64, 3), jax.random.key(0)
+    )
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(state, step=0, force=True)
+    mgr.wait()
+    mgr.close()
+
+    manifest = convert_model.main(
+        ["--snapshot-path", str(tmp_path / "ckpt"),
+         "--output", str(tmp_path / "exp"),
+         "--num-classes", "3", "--backbone", "resnet_test", "--f32",
+         "--buckets", "64x64", "--batch-sizes", "1,2",
+         "--image-min-side", "64", "--image-max-side", "64",
+         "--score-threshold", "0.001", "--platform", "cpu"]
+    )
+    assert manifest.endswith("manifest.json")
+
+    engine = DetectEngine.from_export(str(tmp_path / "exp"))
+    assert engine.buckets == ((64, 64),)
+    assert engine.batch_sizes((64, 64)) == [1, 2]
+    rng = np.random.default_rng(0)
+    with DetectionServer(
+        engine, ServeConfig(max_delay_ms=5, preprocess_workers=1)
+    ) as srv:
+        dets = srv.submit(
+            rng.integers(0, 256, (70, 60, 3), dtype=np.uint8)
+        ).result(timeout=120)
+    assert isinstance(dets, list)
+    for d in dets:
+        assert set(d) == {"category_id", "bbox", "score"}
 
 
 @pytest.mark.slow

@@ -21,7 +21,7 @@ CLUSTER = python -m batchai_retinanet_horovod_coco_tpu.launch.cluster
 .PHONY: create submit status delete test test-timings smoke bench \
 	bench-check bench-pipeline pipebench pipebench-check evalbench \
 	evalbench-check servebench servebench-check canaries \
-	convergence-full lint-obs
+	convergence-full lint lint-obs check-static
 
 create:
 	$(CLUSTER) create --name $(NAME) --zone $(ZONE) --accelerator $(ACCEL) $(DRYFLAG)
@@ -98,10 +98,30 @@ servebench-check:
 canaries:
 	python -m pytest tests/distributed/test_spatial_train.py -q -k canary
 
-# Static watchdog-coverage audit (ISSUE 3, sibling of audit_collectives):
-# every threading.Thread/mp.Process spawn site in the package must
-# register with the obs watchdog or carry a '# watchdog: <why>' rationale.
-# Also runs in tier-1 (tests/unit/test_obs.py::test_audit_threads_clean).
+# Invariant lint engine (ISSUE 5): project-wide AST passes encoding the
+# repo's concurrency/jit/clock/collective contracts — bounded-queues,
+# thread-error-contract, jit-purity, monotonic-clock, collective-safety,
+# watchdog-coverage — against the committed baseline
+# (batchai_retinanet_horovod_coco_tpu/analysis/baseline.json; new findings
+# fail, fixed grandfathered ones must be removed via --update-baseline, so
+# the baseline only shrinks).  `make lint` = engine + both legacy audits
+# (the watchdog shim, and the HLO collective audit at reduced width on a
+# tiny virtual mesh — the slow leg, ~1 min of XLA compile).  Suppression
+# grammar: '# lint: <rule>: <why>' with a REQUIRED rationale.  Also runs
+# in tier-1 (tests/unit/test_lint.py::TestLiveTree).
+lint:
+	python -m batchai_retinanet_horovod_coco_tpu.analysis
+	python scripts/audit_threads.py
+	python scripts/audit_collectives.py --reduced --devices 2
+
+# bench-check-style aggregate for everything static: one target CI can run
+# without touching a chip or a dataset.
+check-static: lint
+	@echo "check-static: lint engine + watchdog audit + HLO collective audit all green"
+
+# Static watchdog-coverage audit alone (ISSUE 3; now a shim over the lint
+# engine's watchdog-coverage rule — same CLI, same exit codes).  Also runs
+# in tier-1 (tests/unit/test_obs.py::test_audit_threads_clean).
 lint-obs:
 	python scripts/audit_threads.py
 

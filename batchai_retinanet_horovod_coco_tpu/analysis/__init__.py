@@ -1,0 +1,27 @@
+"""Static-analysis subsystem: the invariant lint engine and its rules.
+
+Stdlib-only and jax-free by design (the engine runs in CI shells and
+pre-push hooks where paying a jax import would be absurd, and it lints
+jax-free processes' code).  See ``engine.py`` for the architecture and
+``rules/`` for the six encoded contracts:
+
+- ``bounded-queues``        queue constructions must pass maxsize
+- ``thread-error-contract`` thread bodies forward crashes to the driver
+- ``jit-purity``            no host effects inside jit/shard_map bodies
+- ``monotonic-clock``       one clock (obs.trace.monotonic_s) for durations
+- ``collective-safety``     no collectives under rank-conditional branches
+- ``watchdog-coverage``     every spawn site registers with the watchdog
+
+Entry point: ``python -m batchai_retinanet_horovod_coco_tpu.analysis``
+(``make lint``).
+"""
+
+from batchai_retinanet_horovod_coco_tpu.analysis.engine import (  # noqa: F401
+    RULES,
+    Finding,
+    default_baseline_path,
+    lint_source,
+    load_baseline,
+    run,
+    write_baseline,
+)

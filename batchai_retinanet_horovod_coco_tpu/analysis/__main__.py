@@ -1,0 +1,83 @@
+"""CLI for the invariant lint engine (``make lint``).
+
+Exit codes: 0 clean (new findings = 0, stale baseline entries = 0),
+1 otherwise.  ``--update-baseline`` rewrites the committed baseline from
+the current findings — the sanctioned way to SHRINK it after fixing a
+grandfathered violation (adding new entries is a review-visible diff).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from batchai_retinanet_horovod_coco_tpu.analysis import engine
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m batchai_retinanet_horovod_coco_tpu.analysis",
+        description=__doc__.splitlines()[0],
+    )
+    ap.add_argument("root", nargs="?", default=None,
+                    help="repo root to lint (default: this checkout)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full machine-readable report")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="run only this rule (repeatable)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: analysis/baseline.json)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from current findings")
+    args = ap.parse_args(argv)
+
+    if args.update_baseline and args.rule:
+        # A single-rule run sees only that rule's findings; rewriting the
+        # baseline from it would silently drop every OTHER rule's
+        # grandfathered entries and fail the next full run.
+        print("lint: --update-baseline requires a full run "
+              "(drop --rule)", file=sys.stderr)
+        return 2
+
+    try:
+        report = engine.run(args.root, baseline_path=args.baseline,
+                            rule_names=args.rule)
+    except ValueError as e:
+        print(f"lint: {e}", file=sys.stderr)
+        return 2
+    if args.update_baseline:
+        path = args.baseline or engine.default_baseline_path()
+        engine.write_baseline(path, [
+            engine.Finding(**f) for f in report["findings"]
+        ])
+        print(f"lint: baseline rewritten with "
+              f"{len(report['findings'])} entr(y/ies) -> {path}")
+        return 0
+    if args.json:
+        print(json.dumps(report))
+        return 0 if report["ok"] else 1
+
+    for f in report["new"]:
+        print(f"{f['path']}:{f['line']}: [{f['rule']}] {f['message']}")
+    for e in report["stale_baseline"]:
+        print(f"STALE baseline entry ({e['rule']}, {e['path']}): "
+              f"{e['snippet']!r} no longer found — run --update-baseline "
+              "to shrink the baseline")
+    n_new, n_stale = len(report["new"]), len(report["stale_baseline"])
+    n_gf, n_sup = len(report["grandfathered"]), len(report["suppressed"])
+    print(
+        f"lint: {report['files_scanned']} files, "
+        f"{len(report['rules'])} rules, sites inspected "
+        f"{sum(report['stats'].values())} — "
+        f"{n_new} new, {n_gf} grandfathered, {n_sup} suppressed, "
+        f"{n_stale} stale baseline"
+    )
+    if report["unused_suppressions"]:
+        print(f"note: {len(report['unused_suppressions'])} unused "
+              "suppression(s) (see --json) — consider removing them")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,396 @@
+"""Invariant lint engine: shared machinery for project-wide AST passes.
+
+The scaling, observability, and serving layers all rest on invariants that
+were previously enforced only by convention plus two bespoke one-off audit
+scripts (``scripts/audit_collectives.py``, ``scripts/audit_threads.py``):
+bounded queues with shed reasons, crash-re-raise error contracts on every
+long-lived thread, ONE monotonic clock, pure jit bodies, and collectives
+that every host reaches unconditionally.  This module is the engine those
+invariants are encoded against (the rules live in ``analysis/rules/``):
+
+- **Rule registry** — rules self-register via the ``@register`` decorator;
+  each rule is a pure function of one parsed file (``FileContext``) and
+  returns ``Finding``s.  Rules are lexical AST passes: no imports of the
+  linted code, no jax, stdlib only (this package must stay importable in
+  jax-free processes, e.g. shm decode workers' CI checks).
+- **Uniform suppression grammar** — ``# lint: <rule>[,<rule>...]: <why>``
+  on the offending line or the line directly above it.  The rationale is
+  REQUIRED non-empty: a suppression without a why, or naming an unknown
+  rule, is itself a finding (rule name ``suppression``).  Suppressions are
+  tracked, and unused ones are reported (informationally) in the JSON
+  report so dead exemptions can be garbage-collected.
+- **Committed baseline** — ``analysis/baseline.json`` grandfathers known
+  findings by line-number-insensitive fingerprint ``(rule, path, snippet)``
+  so new violations fail while old ones stay tracked.  The baseline is
+  NON-GROWING by construction: a run fails on new findings AND on stale
+  baseline entries (a fixed finding must be removed from the baseline via
+  ``--update-baseline``, so the file only ever shrinks).
+- **JSON report** — ``--json`` emits one machine-readable object (findings,
+  new/grandfathered/stale split, suppressions used and unused, per-rule
+  site counters proving the pass actually inspected constructs).
+
+Run:
+    python -m batchai_retinanet_horovod_coco_tpu.analysis            # lint
+    python -m batchai_retinanet_horovod_coco_tpu.analysis --json
+    python -m batchai_retinanet_horovod_coco_tpu.analysis --update-baseline
+
+Wired into ``make lint`` / ``make check-static`` and tier-1
+(tests/unit/test_lint.py::test_tree_is_clean).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import tokenize
+from collections import Counter
+from typing import Callable, Iterable
+
+#: Reserved rule name for engine-level suppression-grammar findings.
+SUPPRESSION_RULE = "suppression"
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*lint:\s*(?P<rules>[A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)\s*"
+    r":(?P<why>.*)$"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation at one site."""
+
+    rule: str
+    path: str  # repo-relative (stable across checkouts; baseline key part)
+    line: int  # 1-based; NOT part of the baseline key (lines drift)
+    message: str
+    snippet: str = ""  # stripped source line; the line-insensitive key part
+
+    def key(self) -> tuple[str, str, str]:
+        return (self.rule, self.path, self.snippet)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class Suppression:
+    """A parsed ``# lint: <rules>: <why>`` comment."""
+
+    line: int  # line the comment sits on
+    applies_to: int  # code line it covers (own line, or first code line below)
+    rules: tuple[str, ...]
+    why: str
+    used: bool = False
+
+
+class FileContext:
+    """Everything a rule may look at for one file: source, lines, AST,
+    parsed suppressions, and where the file sits (package vs. script —
+    some sub-checks only bind inside the package)."""
+
+    def __init__(self, path: str, relpath: str, source: str,
+                 in_package: bool = True):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.in_package = in_package
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.suppressions = _parse_suppressions(source, self.lines)
+        self.stats: Counter = Counter()  # per-rule inspected-site counters
+
+    # -- helpers rules share -------------------------------------------
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(self, rule: str, line: int, message: str) -> Finding:
+        return Finding(rule=rule, path=self.relpath, line=line,
+                       message=message, snippet=self.snippet(line))
+
+    def count(self, rule: str, n: int = 1) -> None:
+        """Record that ``rule`` actually inspected ``n`` constructs here —
+        the non-vacuity evidence the clean-tree test asserts on."""
+        self.stats[rule] += n
+
+
+def _parse_suppressions(source: str, lines: list[str]) -> list[Suppression]:
+    """Tokenize-based comment scan (regex over raw lines would misfire on
+    ``# lint:`` text inside string literals)."""
+    comments: list[tuple[int, int, str]] = []  # (line, col, text)
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                comments.append((tok.start[0], tok.start[1], tok.string))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return []
+    out = []
+    for lineno, col, text in comments:
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        rules = tuple(r.strip() for r in m.group("rules").split(","))
+        why = m.group("why").strip()
+        # Own line if the comment trails code; otherwise the first
+        # non-blank, non-comment line below it.
+        own = lines[lineno - 1][:col].strip() if lineno <= len(lines) else ""
+        applies_to = lineno
+        if not own:
+            j = lineno  # 0-based index of the next line
+            while j < len(lines):
+                stripped = lines[j].strip()
+                if stripped and not stripped.startswith("#"):
+                    applies_to = j + 1
+                    break
+                j += 1
+        out.append(Suppression(line=lineno, applies_to=applies_to,
+                               rules=rules, why=why))
+    return out
+
+
+# ---- rule registry -------------------------------------------------------
+
+#: name -> (description, check(ctx) -> list[Finding])
+RULES: dict[str, tuple[str, Callable[[FileContext], list[Finding]]]] = {}
+
+
+def register(name: str, description: str):
+    """Decorator: publish a rule under ``name`` in the registry."""
+
+    def deco(fn: Callable[[FileContext], list[Finding]]):
+        if name == SUPPRESSION_RULE:
+            raise ValueError(f"rule name {name!r} is reserved")
+        RULES[name] = (description, fn)
+        return fn
+
+    return deco
+
+
+def _ensure_rules_loaded() -> None:
+    # Import for the registration side effect; cheap and idempotent.
+    from batchai_retinanet_horovod_coco_tpu.analysis import rules  # noqa: F401
+
+
+# ---- per-file run --------------------------------------------------------
+
+@dataclasses.dataclass
+class FileResult:
+    findings: list[Finding]
+    suppressed: list[Finding]
+    grammar_findings: list[Finding]  # bad suppression comments
+    unused_suppressions: list[Suppression]
+    stats: Counter
+
+
+def _validate_rule_names(rule_names: Iterable[str] | None) -> list[str]:
+    """Resolve a rule selection to known names; raise on typos (a typo'd
+    ``--rule`` must not die with a raw KeyError deep in the walk)."""
+    if rule_names is None:
+        return sorted(RULES)
+    names = list(rule_names)
+    unknown = [n for n in names if n not in RULES]
+    if unknown:
+        raise ValueError(
+            f"unknown rule(s) {unknown} (known: {sorted(RULES)})"
+        )
+    return names
+
+
+def lint_source(path: str, relpath: str, source: str, *,
+                rule_names: Iterable[str] | None = None,
+                in_package: bool = True) -> FileResult:
+    """Run the (selected) rules over one file's source."""
+    _ensure_rules_loaded()
+    names = _validate_rule_names(rule_names)
+    try:
+        ctx = FileContext(path, relpath, source, in_package=in_package)
+    except SyntaxError as e:
+        f = Finding(rule=SUPPRESSION_RULE, path=relpath, line=e.lineno or 0,
+                    message=f"unparseable file: {e.msg}", snippet="")
+        return FileResult([f], [], [], [], Counter())
+
+    grammar: list[Finding] = []
+    valid: list[Suppression] = []
+    for sup in ctx.suppressions:
+        bad = False
+        if not sup.why:
+            grammar.append(ctx.finding(
+                SUPPRESSION_RULE, sup.line,
+                "suppression missing rationale: '# lint: <rule>: <why>' "
+                "requires a non-empty why",
+            ))
+            bad = True
+        unknown = [r for r in sup.rules if r not in RULES]
+        if unknown:
+            grammar.append(ctx.finding(
+                SUPPRESSION_RULE, sup.line,
+                f"suppression names unknown rule(s) {unknown} "
+                f"(known: {sorted(RULES)})",
+            ))
+            bad = True
+        if not bad:
+            valid.append(sup)
+
+    raw: list[Finding] = []
+    for name in names:
+        _desc, fn = RULES[name]
+        raw.extend(fn(ctx))
+
+    kept: list[Finding] = []
+    suppressed: list[Finding] = []
+    for f in raw:
+        sup = _match_suppression(valid, f)
+        if sup is not None:
+            sup.used = True
+            suppressed.append(f)
+        else:
+            kept.append(f)
+    unused = [s for s in valid if not s.used]
+    return FileResult(kept, suppressed, grammar, unused, ctx.stats)
+
+
+def _match_suppression(sups: list[Suppression], f: Finding):
+    for sup in sups:
+        if f.rule in sup.rules and f.line in (sup.line, sup.applies_to):
+            return sup
+    return None
+
+
+# ---- tree walk -----------------------------------------------------------
+
+PACKAGE_NAME = "batchai_retinanet_horovod_coco_tpu"
+
+#: Directories under scripts/ that are NOT linted: xla_repros holds
+#: filing-ready standalone upstream repro scripts whose text is frozen.
+_SCRIPT_EXCLUDES = {"xla_repros", "__pycache__"}
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def iter_target_files(root: str | None = None):
+    """Yield ``(abspath, relpath, in_package)`` for every linted file: the
+    whole package tree, top-level driver scripts, and scripts/ (tests and
+    the frozen xla repro scripts excluded)."""
+    root = root or repo_root()
+    pkg = os.path.join(root, PACKAGE_NAME)
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                p = os.path.join(dirpath, fn)
+                yield p, os.path.relpath(p, root), True
+    for fn in sorted(os.listdir(root)):
+        if fn.endswith(".py"):
+            yield os.path.join(root, fn), fn, False
+    scripts = os.path.join(root, "scripts")
+    if os.path.isdir(scripts):
+        for dirpath, dirnames, filenames in os.walk(scripts):
+            dirnames[:] = sorted(
+                d for d in dirnames if d not in _SCRIPT_EXCLUDES
+            )
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    p = os.path.join(dirpath, fn)
+                    yield p, os.path.relpath(p, root), False
+
+
+# ---- baseline ------------------------------------------------------------
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "baseline.json")
+
+
+def load_baseline(path: str) -> Counter:
+    """Multiset of grandfathered finding keys."""
+    if not os.path.exists(path):
+        return Counter()
+    with open(path) as f:
+        data = json.load(f)
+    return Counter(
+        (e["rule"], e["path"], e["snippet"]) for e in data.get("entries", [])
+    )
+
+
+def write_baseline(path: str, findings: list[Finding]) -> None:
+    entries = sorted(
+        ({"rule": f.rule, "path": f.path, "snippet": f.snippet}
+         for f in findings),
+        key=lambda e: (e["path"], e["rule"], e["snippet"]),
+    )
+    with open(path, "w") as f:
+        json.dump({"version": 1, "entries": entries}, f, indent=1,
+                  sort_keys=True)
+        f.write("\n")
+
+
+# ---- whole-run driver ----------------------------------------------------
+
+def run(root: str | None = None, *, baseline_path: str | None = None,
+        rule_names: Iterable[str] | None = None) -> dict:
+    """Lint the tree, split findings against the baseline, return the
+    report object (the ``--json`` payload)."""
+    _ensure_rules_loaded()
+    _validate_rule_names(rule_names)
+    root = root or repo_root()
+    baseline_path = baseline_path or default_baseline_path()
+    baseline = load_baseline(baseline_path)
+
+    findings: list[Finding] = []
+    suppressed: list[Finding] = []
+    grammar: list[Finding] = []
+    unused: list[dict] = []
+    stats: Counter = Counter()
+    files_scanned = 0
+    for path, relpath, in_pkg in iter_target_files(root):
+        with open(path) as f:
+            source = f.read()
+        res = lint_source(path, relpath, source, rule_names=rule_names,
+                          in_package=in_pkg)
+        files_scanned += 1
+        findings.extend(res.findings)
+        suppressed.extend(res.suppressed)
+        grammar.extend(res.grammar_findings)
+        stats.update(res.stats)
+        unused.extend(
+            {"path": relpath, "line": s.line, "rules": list(s.rules),
+             "why": s.why}
+            for s in res.unused_suppressions
+        )
+
+    # Bad suppression comments are never baselinable: they fail outright.
+    remaining = Counter(baseline)
+    new: list[Finding] = []
+    grandfathered: list[Finding] = []
+    for f in findings:
+        if remaining[f.key()] > 0:
+            remaining[f.key()] -= 1
+            grandfathered.append(f)
+        else:
+            new.append(f)
+    stale = [
+        {"rule": r, "path": p, "snippet": s, "missing": n}
+        for (r, p, s), n in sorted(remaining.items()) if n > 0
+    ]
+    return {
+        "root": root,
+        "rules": sorted(rule_names) if rule_names else sorted(RULES),
+        "files_scanned": files_scanned,
+        "stats": dict(sorted(stats.items())),
+        "findings": [f.to_dict() for f in findings],
+        "new": [f.to_dict() for f in new + grammar],
+        "grandfathered": [f.to_dict() for f in grandfathered],
+        "stale_baseline": stale,
+        "suppressed": [f.to_dict() for f in suppressed],
+        "unused_suppressions": unused,
+        "ok": not new and not grammar and not stale,
+    }

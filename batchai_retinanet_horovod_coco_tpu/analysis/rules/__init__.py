@@ -1,0 +1,11 @@
+"""The lint rules. Importing this package registers every rule with the
+engine registry (engine._ensure_rules_loaded does exactly that)."""
+
+from batchai_retinanet_horovod_coco_tpu.analysis.rules import (  # noqa: F401
+    bounded_queues,
+    collective_safety,
+    jit_purity,
+    monotonic_clock,
+    thread_error_contract,
+    watchdog_coverage,
+)

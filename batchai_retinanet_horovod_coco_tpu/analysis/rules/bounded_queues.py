@@ -1,0 +1,87 @@
+"""bounded-queues: every queue construction must be bounded (or say why).
+
+The serve layer exists to shed load instead of queueing it unboundedly
+(503 + reason beats an OOM an hour later), and the data/eval pipelines use
+bounded queues as their backpressure mechanism — an unbounded queue anywhere
+in a producer/consumer chain silently converts a slow consumer into
+unbounded host-memory growth.  This rule requires every
+``queue.Queue``/``mp.Queue``-family construction to pass ``maxsize`` (as a
+positional or keyword argument), or to carry a ``# lint: bounded-queues:
+<why>`` rationale (e.g. "bounded by the slot-token protocol").
+
+``SimpleQueue`` cannot be bounded at all, so it is always flagged: either
+switch to ``Queue(maxsize=...)`` or justify the unboundedness.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from batchai_retinanet_horovod_coco_tpu.analysis.engine import (
+    FileContext,
+    Finding,
+    register,
+)
+from batchai_retinanet_horovod_coco_tpu.analysis.rules.common import (
+    callee_name,
+)
+
+NAME = "bounded-queues"
+
+
+def _literal_value(node: ast.expr):
+    """Fold a (possibly sign-prefixed) numeric literal; None otherwise."""
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        inner = _literal_value(node.operand)
+        return None if inner is None else -inner
+    if isinstance(node, ast.Constant) and isinstance(node.value, (int, float)):
+        return node.value
+    return None
+
+#: Constructors taking maxsize (queue.*, multiprocessing context queues,
+#: asyncio.Queue all share the signature).
+BOUNDABLE = frozenset({"Queue", "LifoQueue", "PriorityQueue", "JoinableQueue"})
+#: Constructors with NO capacity knob at all.
+UNBOUNDABLE = frozenset({"SimpleQueue"})
+
+
+@register(NAME, "queue constructions must pass maxsize or carry a rationale")
+def check(ctx: FileContext) -> list[Finding]:
+    out: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = callee_name(node)
+        if name in UNBOUNDABLE:
+            ctx.count(NAME)
+            out.append(ctx.finding(
+                NAME, node.lineno,
+                f"{name}() has no capacity bound — use Queue(maxsize=...) "
+                "or justify with '# lint: bounded-queues: <why>'",
+            ))
+        elif name in BOUNDABLE:
+            ctx.count(NAME)
+            maxsize = node.args[0] if node.args else None
+            for kw in node.keywords:
+                if kw.arg == "maxsize":
+                    maxsize = kw.value
+            if maxsize is None:
+                out.append(ctx.finding(
+                    NAME, node.lineno,
+                    f"{name}() constructed without maxsize — unbounded "
+                    "queueing defeats backpressure/shedding; bound it or "
+                    "justify with '# lint: bounded-queues: <why>'",
+                ))
+            else:
+                value = _literal_value(maxsize)
+                if value is not None and value <= 0:
+                    # Stdlib semantics: maxsize <= 0 means INFINITE — an
+                    # explicitly-spelled unbounded queue is still unbounded.
+                    out.append(ctx.finding(
+                        NAME, node.lineno,
+                        f"{name}(maxsize={value}) is unbounded by "
+                        "stdlib semantics (<= 0 means infinite) — use a "
+                        "positive bound or justify with "
+                        "'# lint: bounded-queues: <why>'",
+                    ))
+    return out

@@ -1,0 +1,99 @@
+"""collective-safety: no collectives under rank-conditional branches.
+
+The static sibling of the HLO-level ``scripts/audit_collectives.py``: a
+``psum``/``pmean``/``all_gather``/... that only SOME hosts reach is a
+silent multi-host deadlock — the participating hosts block in the
+collective forever while the skipping host runs ahead (the classic
+``if process_index() == 0: checkpoint(psum(...))`` bug; the comms schedule
+is *the* scaling artifact, and it must be unconditional).
+
+This rule flags any collective call that sits lexically inside an ``if`` /
+``while`` / ternary whose test mentions a rank-ish identifier
+(``process_index``, ``process_count``, ``rank``, ``local_rank``,
+``host_id``).  Lexical means conservative: a collective in EITHER branch
+of a rank-conditional is flagged (both-branches-collective is still a
+different schedule per host).  Rank-conditional HOST-side work (logging,
+checkpoint writes) is fine — only collective calls under the branch are
+findings.  Suppress with ``# lint: collective-safety: <why>`` when every
+host provably takes the same branch (e.g. the condition is
+replica-identical by construction).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from batchai_retinanet_horovod_coco_tpu.analysis.engine import (
+    FileContext,
+    Finding,
+    register,
+)
+from batchai_retinanet_horovod_coco_tpu.analysis.rules.common import (
+    callee_name,
+)
+
+NAME = "collective-safety"
+
+COLLECTIVES = frozenset({
+    "psum", "pmean", "pmax", "pmin", "psum_scatter", "all_gather",
+    "all_to_all", "ppermute", "pshuffle", "axis_index_groups",
+})
+_RANKY = frozenset({
+    "process_index", "process_count", "rank", "local_rank", "host_id",
+})
+
+
+def _ranky_names(test: ast.expr) -> list[str]:
+    found = []
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name) and node.id in _RANKY:
+            found.append(node.id)
+        elif isinstance(node, ast.Attribute) and node.attr in _RANKY:
+            found.append(node.attr)
+    return found
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.findings: list[Finding] = []
+        self._cond_stack: list[str] = []  # ranky names guarding this scope
+
+    def _visit_conditional(self, node, test, bodies):
+        ranky = _ranky_names(test)
+        self.visit(test)
+        if ranky:
+            self._cond_stack.append(ranky[0])
+        for child in bodies:
+            self.visit(child)
+        if ranky:
+            self._cond_stack.pop()
+
+    def visit_If(self, node: ast.If):
+        self._visit_conditional(node, node.test, node.body + node.orelse)
+
+    def visit_While(self, node: ast.While):
+        self._visit_conditional(node, node.test, node.body + node.orelse)
+
+    def visit_IfExp(self, node: ast.IfExp):
+        self._visit_conditional(node, node.test, [node.body, node.orelse])
+
+    def visit_Call(self, node: ast.Call):
+        name = callee_name(node)
+        if name in COLLECTIVES:
+            self.ctx.count(NAME)
+            if self._cond_stack:
+                self.findings.append(self.ctx.finding(
+                    NAME, node.lineno,
+                    f"collective '{name}' under a rank-conditional branch "
+                    f"(test mentions '{self._cond_stack[-1]}') — a host "
+                    "that skips it deadlocks every host that doesn't",
+                ))
+        self.generic_visit(node)
+
+
+@register(NAME, "every host must reach every collective unconditionally")
+def check(ctx: FileContext) -> list[Finding]:
+    v = _Visitor(ctx)
+    v.visit(ctx.tree)
+    return v.findings

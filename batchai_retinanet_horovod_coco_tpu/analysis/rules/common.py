@@ -1,0 +1,86 @@
+"""Small AST helpers shared by the lint rules (stdlib-only, jax-free)."""
+
+from __future__ import annotations
+
+import ast
+
+
+def callee_name(call: ast.Call) -> str | None:
+    """Terminal name of the callee: ``Thread`` for both ``Thread(...)``
+    and ``threading.Thread(...)``."""
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def dotted(node: ast.expr) -> str | None:
+    """``a.b.c`` for an Attribute/Name chain, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def def_map(tree: ast.AST) -> dict[str, ast.AST]:
+    """Every function/lambda-less def in the module by BARE name (methods
+    included — ``self._producer`` resolves via ``_producer``).  Last def
+    wins on (rare) collisions; rules that resolve through this map are
+    best-effort lexical passes, not a type checker."""
+    out: dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out[node.name] = node
+    return out
+
+
+def resolve_callable(expr: ast.expr, defs: dict[str, ast.AST],
+                     depth: int = 3) -> ast.AST | None:
+    """Best-effort: the function body behind an expression passed as a
+    callable (``target=self._run``, ``functools.partial(fn, x)``,
+    ``lambda: fn(x)``).  Returns a FunctionDef/Lambda node or None."""
+    if depth <= 0:
+        return None
+    if isinstance(expr, ast.Lambda):
+        # A lambda that just adapts arguments: chase the called function.
+        if isinstance(expr.body, ast.Call):
+            inner = resolve_callable(expr.body.func, defs, depth - 1)
+            if inner is not None:
+                return inner
+        return expr
+    if isinstance(expr, ast.Name):
+        return defs.get(expr.id)
+    if isinstance(expr, ast.Attribute):
+        return defs.get(expr.attr)
+    if isinstance(expr, ast.Call) and callee_name(expr) == "partial":
+        if expr.args:
+            return resolve_callable(expr.args[0], defs, depth - 1)
+    return None
+
+
+def module_aliases(tree: ast.AST, module: str) -> set[str]:
+    """Local names bound to ``module`` by imports: ``import numpy as np``
+    -> {'np'}, ``import numpy`` -> {'numpy'}."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == module:
+                    out.add(a.asname or a.name)
+    return out
+
+
+def from_imports(tree: ast.AST, module: str) -> dict[str, str]:
+    """``from <module> import x as y`` -> {'y': 'x'}."""
+    out: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == module:
+            for a in node.names:
+                out[a.asname or a.name] = a.name
+    return out

@@ -1,0 +1,138 @@
+"""jit-purity: no host effects inside functions handed to jit/shard_map.
+
+A traced function runs ONCE at compile time; host-side effects inside it
+(``time.time``, ``print``, ``np.random``, ``.item()``, file I/O) either bake
+a compile-time constant into the executable (the classic "my timestamp never
+changes" bug), force a silent device→host sync, or simply never execute
+again after tracing.  The AOT paths (``.lower().compile()``) make this
+worse: the traced value is frozen into a serialized executable.
+
+This rule finds the functions passed to ``jax.jit`` / ``shard_map`` (as
+call arguments, decorators, or ``functools.partial(jax.jit, ...)``
+decorators), resolves them lexically within the file (named defs, methods,
+lambdas), and flags host-effect calls anywhere in the resolved body
+(nested defs included).  ``jax.debug.print``/``jax.debug.callback`` are the
+sanctioned in-jit effects and are not flagged.  Cross-module callees are
+out of scope (lexical pass).  Suppress with ``# lint: jit-purity: <why>``
+on the offending line (e.g. an intentional trace-time log).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from batchai_retinanet_horovod_coco_tpu.analysis.engine import (
+    FileContext,
+    Finding,
+    register,
+)
+from batchai_retinanet_horovod_coco_tpu.analysis.rules.common import (
+    callee_name,
+    def_map,
+    dotted,
+    module_aliases,
+    resolve_callable,
+)
+
+NAME = "jit-purity"
+
+_JIT_NAMES = frozenset({"jit", "shard_map", "pmap"})
+_BANNED_BUILTINS = frozenset({"print", "input", "breakpoint", "open"})
+_BANNED_TIME = frozenset({"time", "monotonic", "perf_counter", "sleep",
+                          "time_ns", "monotonic_ns"})
+_HOST_SYNC_METHODS = frozenset({"item"})
+
+
+def _is_jit_ref(expr: ast.expr) -> bool:
+    """``jit`` / ``jax.jit`` / ``shard_map`` / ``jax.experimental...``."""
+    if isinstance(expr, ast.Name):
+        return expr.id in _JIT_NAMES
+    if isinstance(expr, ast.Attribute):
+        return expr.attr in _JIT_NAMES
+    return False
+
+
+def _jit_entry_targets(tree: ast.AST):
+    """Yield (site_lineno, target_expr_or_fndef) for every jit entry."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jit_ref(node.func):
+            if node.args:
+                yield node.lineno, node.args[0]
+            else:
+                # jit(static_argnames=...) factory: the target arrives via
+                # a decorator or a later call — those sites handle it.
+                for kw in node.keywords:
+                    if kw.arg in ("f", "fun", "func"):
+                        yield node.lineno, kw.value
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_jit_ref(dec):
+                    yield node.lineno, node
+                elif isinstance(dec, ast.Call):
+                    if _is_jit_ref(dec.func):
+                        # @jit(...) / @shard_map(mesh=...) factory form.
+                        if not dec.args:
+                            yield node.lineno, node
+                    elif callee_name(dec) == "partial" and any(
+                        _is_jit_ref(a) for a in dec.args[:1]
+                    ):
+                        # @functools.partial(jax.jit, static_argnames=...)
+                        yield node.lineno, node
+
+
+def _banned_calls(fn: ast.AST, np_aliases: set[str],
+                  random_aliases: set[str]):
+    """Yield (lineno, description) for host-effect calls in the body."""
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in _BANNED_BUILTINS:
+            yield node.lineno, f"{f.id}()"
+            continue
+        if not isinstance(f, ast.Attribute):
+            continue
+        path = dotted(f)
+        if path is None:
+            # Method on a computed value: only the host-sync set applies.
+            if f.attr in _HOST_SYNC_METHODS and not node.args:
+                yield node.lineno, f".{f.attr}()"
+            continue
+        parts = path.split(".")
+        root = parts[0]
+        if root == "time" and f.attr in _BANNED_TIME:
+            yield node.lineno, f"{path}()"
+        elif root in np_aliases and len(parts) >= 2 and parts[1] == "random":
+            yield node.lineno, f"{path}() (host RNG traces to a constant)"
+        elif root in random_aliases and len(parts) == 2:
+            yield node.lineno, f"{path}() (host RNG traces to a constant)"
+        elif f.attr in _HOST_SYNC_METHODS and not node.args and root != "jax":
+            yield node.lineno, f".{f.attr}() (forces device->host sync)"
+
+
+@register(NAME, "functions passed to jit/shard_map must be host-effect-free")
+def check(ctx: FileContext) -> list[Finding]:
+    defs = def_map(ctx.tree)
+    np_aliases = module_aliases(ctx.tree, "numpy")
+    random_aliases = module_aliases(ctx.tree, "random")
+    out: list[Finding] = []
+    seen: set[tuple[int, int]] = set()
+    for site_line, target in _jit_entry_targets(ctx.tree):
+        fn = (target if isinstance(
+            target, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda))
+            else resolve_callable(target, defs))
+        if fn is None:
+            continue  # cross-module callee — lexically out of scope
+        ctx.count(NAME)
+        fname = getattr(fn, "name", "<lambda>")
+        for lineno, desc in _banned_calls(fn, np_aliases, random_aliases):
+            if (id(fn), lineno) in seen:
+                continue
+            seen.add((id(fn), lineno))
+            out.append(ctx.finding(
+                NAME, lineno,
+                f"host effect {desc} inside jit-compiled '{fname}' "
+                f"(jit entry at line {site_line}) — traced once at compile "
+                "time, not per step; hoist it out or use jax.debug.*",
+            ))
+    return out

@@ -1,0 +1,81 @@
+"""monotonic-clock: one clock for durations — ``obs.trace.monotonic_s``.
+
+The obs subsystem standardized every timestamp (spans, JSONL events,
+watchdog heartbeats, serve deadlines) on ONE clock, ``obs.trace
+.monotonic_s()``, so any two timestamps in a run are mutually comparable
+and immune to wall-clock steps (NTP slew mid-run once made a "negative
+latency" p50).  ``time.time()`` is therefore banned everywhere for
+duration/latency math; where wall time is genuinely meant (run headers,
+the trace exporter's wall anchor) suppress with
+``# lint: monotonic-clock: <why>``.
+
+Inside the package the rule goes further: raw ``time.monotonic()`` /
+``time.perf_counter()`` are also flagged — they are monotonic, but they are
+a SECOND clock; timestamps taken with them cannot be compared against span
+or heartbeat times.  Top-level bench/driver scripts may keep raw
+``perf_counter`` (standalone measurement harnesses that never mix their
+timestamps into the obs stream).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from batchai_retinanet_horovod_coco_tpu.analysis.engine import (
+    FileContext,
+    Finding,
+    register,
+)
+from batchai_retinanet_horovod_coco_tpu.analysis.rules.common import (
+    dotted,
+    from_imports,
+)
+
+NAME = "monotonic-clock"
+
+_PKG_ONLY = frozenset({"monotonic", "perf_counter", "monotonic_ns",
+                       "perf_counter_ns"})
+
+
+@register(NAME, "time.time() banned for durations; package times with "
+                "obs.trace.monotonic_s")
+def check(ctx: FileContext) -> list[Finding]:
+    # `from time import time` style aliases of the banned callables.
+    aliased = {
+        local: orig
+        for local, orig in from_imports(ctx.tree, "time").items()
+        if orig == "time" or (ctx.in_package and orig in _PKG_ONLY)
+    }
+    out: list[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = None
+        if isinstance(f, ast.Attribute) and dotted(f) is not None:
+            path = dotted(f)
+            if path == "time.time":
+                name = "time.time"
+            elif (ctx.in_package and path is not None
+                  and path.startswith("time.")
+                  and f.attr in _PKG_ONLY):
+                name = path
+        elif isinstance(f, ast.Name) and f.id in aliased:
+            name = f"time.{aliased[f.id]}"
+        if name is None:
+            continue
+        ctx.count(NAME)
+        if name == "time.time":
+            msg = (
+                "time.time() is wall clock — for durations/latency use "
+                "obs.trace.monotonic_s(); if wall time is genuinely meant, "
+                "suppress with '# lint: monotonic-clock: <why>'"
+            )
+        else:
+            msg = (
+                f"{name}() is a second clock — package code times with "
+                "obs.trace.monotonic_s() (THE clock) so timestamps are "
+                "comparable across spans/events/heartbeats"
+            )
+        out.append(ctx.finding(NAME, node.lineno, msg))
+    return out

@@ -1,0 +1,126 @@
+"""thread-error-contract: thread bodies must forward crashes, never eat them.
+
+The shm-pipeline contract (PR 1, inherited by every long-lived thread since):
+an exception in a background thread must re-raise in the driver — delivered
+through the output queue, stored and re-raised at join, or otherwise pushed
+to a crash channel.  A thread whose run loop lets exceptions escape dies
+silently (CPython prints to stderr and the program wedges on a queue that
+will never fill), and a broad ``except: pass`` is the same bug spelled
+differently.
+
+For every ``Thread(target=...)``/``Timer(..., fn)`` whose target resolves to
+a function defined in the same file, this rule requires:
+
+- at least one broad handler (``except:``, ``except Exception``,
+  ``except BaseException``) somewhere in the target's body that does MORE
+  than ``pass`` (i.e. plausibly forwards/records the crash), and
+- no broad handler anywhere in the target whose body is only ``pass``
+  (narrow handlers like ``except queue.Empty: pass`` are the normal poll
+  idiom and stay legal).
+
+Targets that cannot be resolved lexically (imported callables, bound
+methods of other modules) are skipped — this is an AST pass, not a type
+checker.  Suppression anchors follow the finding: the no-forwarding
+finding anchors at the SPAWN site (put ``# lint: thread-error-contract:
+<why>`` there when a thread is genuinely fire-and-forget); the
+broad-except-swallows finding anchors at the offending ``except`` line
+(put the comment on, or directly above, that handler).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from batchai_retinanet_horovod_coco_tpu.analysis.engine import (
+    FileContext,
+    Finding,
+    register,
+)
+from batchai_retinanet_horovod_coco_tpu.analysis.rules.common import (
+    callee_name,
+    def_map,
+    resolve_callable,
+)
+
+NAME = "thread-error-contract"
+
+_SPAWNERS = frozenset({"Thread", "Timer"})
+_BROAD = frozenset({"Exception", "BaseException"})
+
+
+def _target_expr(call: ast.Call) -> ast.expr | None:
+    for kw in call.keywords:
+        if kw.arg in ("target", "function"):
+            return kw.value
+    name = callee_name(call)
+    if name == "Timer" and len(call.args) >= 2:
+        return call.args[1]
+    return None
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [getattr(e, "attr", getattr(e, "id", None)) for e in t.elts]
+    else:
+        names = [getattr(t, "attr", getattr(t, "id", None))]
+    return any(n in _BROAD for n in names)
+
+
+def _is_swallow(handler: ast.ExceptHandler) -> bool:
+    """Body is only pass/.../docstring — the crash evaporates."""
+    for stmt in handler.body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue
+        return False
+    return True
+
+
+@register(NAME, "thread targets must forward exceptions to a crash channel")
+def check(ctx: FileContext) -> list[Finding]:
+    defs = def_map(ctx.tree)
+    out: list[Finding] = []
+    seen_targets: set[int] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if callee_name(node) not in _SPAWNERS:
+            continue
+        expr = _target_expr(node)
+        if expr is None:
+            continue
+        fn = resolve_callable(expr, defs)
+        if fn is None or isinstance(fn, ast.Lambda):
+            continue  # lexically unresolvable — out of scope for this pass
+        ctx.count(NAME)
+        if id(fn) in seen_targets:
+            continue  # one verdict per target function
+        seen_targets.add(id(fn))
+        broad_ok = False
+        for sub in ast.walk(fn):
+            if not isinstance(sub, ast.ExceptHandler):
+                continue
+            if not _is_broad(sub):
+                continue
+            if _is_swallow(sub):
+                out.append(ctx.finding(
+                    NAME, sub.lineno,
+                    f"broad except in thread target '{fn.name}' swallows "
+                    "the crash (body is only pass) — forward it to the "
+                    "driver (queue/put, store-and-re-raise) instead",
+                ))
+            else:
+                broad_ok = True
+        if not broad_ok:
+            out.append(ctx.finding(
+                NAME, node.lineno,
+                f"thread target '{fn.name}' has no broad except forwarding "
+                "crashes to the driver — a failure here dies silently "
+                "(shm-pipeline contract: crash must re-raise in the driver)",
+            ))
+    return out

@@ -1,0 +1,73 @@
+"""watchdog-coverage: every spawn site registers with the stall watchdog.
+
+The engine-resident port of ``scripts/audit_threads.py`` (which survives as
+a thin shim over this rule): the stall watchdog (obs/watchdog.py) only
+diagnoses components that heartbeat, so a ``threading.Thread`` /
+``mp.Process`` / executor spawned without registering is a future "it hung
+and nothing says why".  Every spawn call must have, within ``WINDOW`` lines:
+
+- a ``watchdog.register(`` call (registration at the spawn site), or
+- a legacy ``# watchdog:`` / ``# watchdog-exempt:`` rationale comment
+  (grandfathered grammar, kept so the PR-3/PR-4 era markers stay valid), or
+- a uniform ``# lint: watchdog-coverage: <why>`` suppression on the spawn
+  line or the line above (the engine applies those after this rule runs).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from batchai_retinanet_horovod_coco_tpu.analysis.engine import (
+    FileContext,
+    Finding,
+    register,
+)
+from batchai_retinanet_horovod_coco_tpu.analysis.rules.common import (
+    callee_name,
+)
+
+NAME = "watchdog-coverage"
+
+#: Constructors whose call sites spawn (or pool) concurrent execution.
+SPAWN_NAMES = frozenset(
+    {"Thread", "Timer", "Process", "ThreadPoolExecutor", "ProcessPoolExecutor"}
+)
+
+#: Lines around the spawn call searched for a registration or a rationale.
+WINDOW = 8
+
+MARKER_RE = re.compile(
+    r"#\s*watchdog(?:-exempt)?\s*(?:\((?P<scope>[^)]*)\))?:\s*(?P<why>\S.*)"
+)
+REGISTER_RE = re.compile(r"\bwatchdog\.register\(")
+
+
+def spawn_calls(tree: ast.AST):
+    """Yield (lineno, callee_name) for every spawn-constructor call."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = callee_name(node)
+            if name in SPAWN_NAMES:
+                yield node.lineno, name
+
+
+def violation_message(callee: str) -> str:
+    return (
+        f"{callee}() spawn without watchdog.register( or a "
+        f"'# watchdog: <why>' rationale within {WINDOW} lines"
+    )
+
+
+@register(NAME, "spawn sites must register with the obs stall watchdog")
+def check(ctx: FileContext) -> list[Finding]:
+    out: list[Finding] = []
+    for lineno, callee in spawn_calls(ctx.tree):
+        ctx.count(NAME)
+        lo = max(0, lineno - 1 - WINDOW)
+        hi = min(len(ctx.lines), lineno + WINDOW)
+        window = "\n".join(ctx.lines[lo:hi])
+        if REGISTER_RE.search(window) or MARKER_RE.search(window):
+            continue
+        out.append(ctx.finding(NAME, lineno, violation_message(callee)))
+    return out

@@ -214,10 +214,10 @@ def _cleanup_resources(mp_stop, procs, task_q, result_q, shms, views) -> None:
             task_q.put_nowait(None)
         except Exception:
             pass
-    deadline = time.monotonic() + 5.0
+    deadline = trace.monotonic_s() + 5.0
     for p in procs:
         try:
-            p.join(timeout=max(0.1, deadline - time.monotonic()))
+            p.join(timeout=max(0.1, deadline - trace.monotonic_s()))
         except Exception:
             pass
     for p in procs:
@@ -318,7 +318,12 @@ class _ShmPipeline:
         self.shm_names = [s.name for s in self._shms]
         self._bucket_ids = {b: i for i, b in enumerate(config.buckets)}
 
+        # lint: bounded-queues: in-flight tasks are bounded by the slot
+        # tokens — the coordinator only submits while it holds a free shm
+        # slot, so depth ≤ slots_per_bucket × len(buckets) by protocol.
         self._task_q = ctx.Queue()
+        # lint: bounded-queues: one result per in-flight task; bounded by
+        # the same slot-token protocol as the task queue above.
         self._result_q = ctx.Queue()
         self._mp_stop = ctx.Event()
         # watchdog-exempt (workers): decode workers heartbeat IMPLICITLY
@@ -414,15 +419,15 @@ class _ShmPipeline:
         return stop_gated_put(self._out, item, self._stop)
 
     def _check_workers(self) -> None:
-        self._last_liveness = time.monotonic()
+        self._last_liveness = trace.monotonic_s()
         for p in self.processes:
             if not p.is_alive():
                 # Prefer the worker's own report: a worker that errored
                 # queues a traceback then exits, and the liveness poll can
                 # win the race against the queue's feeder thread.  Grace-
                 # drain briefly before falling back to the generic verdict.
-                grace = time.monotonic() + 1.0
-                while time.monotonic() < grace:
+                grace = trace.monotonic_s() + 1.0
+                while trace.monotonic_s() < grace:
                     try:
                         msg = self._result_q.get_nowait()
                     except queue.Empty:
@@ -450,7 +455,7 @@ class _ShmPipeline:
         head-of-line batch forever; a timeout is the only way to surface
         an alive-but-stuck child).
         """
-        deadline = time.monotonic() + self._config.worker_timeout
+        deadline = trace.monotonic_s() + self._config.worker_timeout
         while not cond():
             if self._stop.is_set():
                 raise _StopRequested
@@ -459,7 +464,7 @@ class _ShmPipeline:
             # queue can stay non-empty indefinitely, and an idle-poll-only
             # check would miss the death until the stream happened to
             # drain (observed as a 30s+ detection gap on a loaded box).
-            if time.monotonic() - self._last_liveness > 0.5:
+            if trace.monotonic_s() - self._last_liveness > 0.5:
                 self._check_workers()
             try:
                 msg = self._result_q.get(timeout=0.1)
@@ -479,10 +484,10 @@ class _ShmPipeline:
                 # heartbeat (workers never register themselves).
                 if self._hb is not None:
                     self._hb.beat()
-                deadline = time.monotonic() + self._config.worker_timeout
+                deadline = trace.monotonic_s() + self._config.worker_timeout
                 continue
             self._check_workers()
-            if time.monotonic() > deadline:
+            if trace.monotonic_s() > deadline:
                 raise RuntimeError(
                     "input pipeline stalled: no progress on the head batch "
                     f"within worker_timeout={self._config.worker_timeout}s "

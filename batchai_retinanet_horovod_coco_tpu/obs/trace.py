@@ -69,8 +69,8 @@ DEFAULT_CAPACITY = 65536
 # (wall, perf) anchor pair: monotonic_s() times map onto the shared wall
 # clock as  wall = _WALL_ANCHOR + (t - _PERF_ANCHOR).  Captured once at
 # import so every ring in this process shares one mapping.
-_WALL_ANCHOR = time.time()
-_PERF_ANCHOR = time.perf_counter()
+_WALL_ANCHOR = time.time()  # lint: monotonic-clock: the wall half of the anchor — wall time IS the point here
+_PERF_ANCHOR = time.perf_counter()  # lint: monotonic-clock: the perf half of the anchor monotonic_s() maps through
 
 _enabled = False
 _trace_dir: str | None = None
@@ -90,6 +90,7 @@ def monotonic_s() -> float:
     wall-clock steps.  Use this instead of ``time.time()`` /
     ``time.perf_counter()`` in instrumented code so every timestamp in a
     run is mutually comparable."""
+    # lint: monotonic-clock: this IS the one clock's implementation
     return time.perf_counter()
 
 

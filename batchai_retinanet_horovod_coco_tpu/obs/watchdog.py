@@ -225,10 +225,20 @@ class Watchdog:
     # ---- poll thread -----------------------------------------------------
 
     def _run(self) -> None:
-        while not self._stop.wait(self.poll_interval):
-            diag = self.check_once()
-            if diag is not None:
-                self._dump(diag)
+        try:
+            while not self._stop.wait(self.poll_interval):
+                diag = self.check_once()
+                if diag is not None:
+                    self._dump(diag)
+        except BaseException as e:
+            # The monitor must never die silently: a crashed poll thread
+            # disarms stall diagnosis for the rest of the run, so announce
+            # the disarm loudly before the thread ends.
+            print(
+                json.dumps({"event": "watchdog_crashed", "error": repr(e)}),
+                file=sys.stderr, flush=True,
+            )
+            raise
 
     def start(self) -> None:
         if self._thread is not None and self._thread.is_alive():

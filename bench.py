@@ -870,10 +870,23 @@ def _serve_closed_loop(server, img, target: int, clients: int) -> dict:
 
     stop = threading.Event()
     lock = threading.Lock()
-    state = {"completed": 0, "shed": 0, "t_warm": None, "t_end": None}
+    state = {"completed": 0, "shed": 0, "t_warm": None, "t_end": None,
+             "errors": []}
     warm = max(1, clients)
 
     def client():
+        try:
+            _client_loop()
+        except BaseException as e:
+            # Crash channel (thread-error-contract): a silently-dead
+            # client skews the closed-loop number, so the crash is
+            # recorded and re-raised as a bench failure after the join.
+            with lock:
+                state["errors"].append(repr(e))
+            stop.set()
+            raise
+
+    def _client_loop():
         while not stop.is_set():
             try:
                 fut = server.submit(img)
@@ -914,6 +927,10 @@ def _serve_closed_loop(server, img, target: int, clients: int) -> dict:
     stop.set()
     for t in threads:
         t.join(timeout=30)
+    if state["errors"]:
+        raise RuntimeError(
+            f"bench client thread(s) crashed: {state['errors'][:3]}"
+        )
     t_warm = state["t_warm"] or t0
     t_end = state["t_end"] or time.perf_counter()
     measured = max(0, state["completed"] - warm)

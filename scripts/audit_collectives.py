@@ -32,10 +32,11 @@ import sys
 
 # Base collective op names; the parser also matches each one's async
 # "-start" form (emitted on backends/flags with async collectives) and
-# folds it into the base name so a schedule audits uniformly.  NOTE:
-# async-start results are (operand, result, ...) tuples, so payloads for
-# "-start" forms can over-count ~2x — the pinned CPU modules are sync,
-# where result-shape payloads are exact.
+# folds it into the base name so a schedule audits uniformly.  Async
+# "-start" results are (operand, result, ...) tuples; the operand half is
+# an aliased copy of the input, so only the RESULT elements are counted
+# (``_async_result_bytes``) — payloads match the sync form exactly, and
+# the matching "-done" halves are never separately counted.
 _COLLECTIVES = (
     "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
     "all-to-all",
@@ -62,21 +63,62 @@ def _shape_bytes(shape_text: str) -> int:
     return total
 
 
+def _split_top_level(shape_text: str) -> list[str]:
+    """Top-level elements of a tuple shape string: ``(f32[3,3]{1,0},
+    (f32[4]{0}, f32[4]{0}))`` -> ['f32[3,3]{1,0}', '(f32[4]{0}, f32[4]{0})'].
+    Returns [] when the text is not a tuple."""
+    s = shape_text.strip()
+    if not s.startswith("("):
+        return []
+    depth = 0
+    elems, start = [], 1
+    for i, c in enumerate(s):
+        if c in "([{":
+            depth += 1
+        elif c in ")]}":
+            depth -= 1
+            if depth == 0:
+                elems.append(s[start:i].strip())
+                break
+        elif c == "," and depth == 1:
+            elems.append(s[start:i].strip())
+            start = i + 1
+    return [e for e in elems if e]
+
+
+def _async_result_bytes(shape_text: str) -> int:
+    """Payload of an async ``op-start`` result WITHOUT the operand alias:
+    start ops return ``(operand, result, ...context)`` tuples, so counting
+    the whole tuple over-counts ~2x vs. the sync form.  Drop the first
+    element (the aliased input) and count the rest; a non-tuple start
+    result (bufferized forms) is counted whole."""
+    elems = _split_top_level(shape_text)
+    if len(elems) < 2:
+        return _shape_bytes(shape_text)
+    return sum(_shape_bytes(e) for e in elems[1:])
+
+
 def audit_hlo_text(txt: str) -> dict:
     """Parse optimized HLO, return {op: {count, payload_bytes}} with
-    async ``op-start`` instructions folded into their base op name
-    (their matching ``op-done`` halves are not separately counted)."""
+    async ``op-start`` instructions folded into their base op name:
+    payload from the start's RESULT elements only (operand-alias halves
+    dropped), and the matching ``op-done`` instructions never separately
+    counted."""
     out: dict[str, dict[str, int]] = {}
     # `%name = SHAPE op-name(operands...)`; SHAPE may be a long tuple, so
     # split the line at the op-name rather than regexing the whole shape.
     for line in txt.splitlines():
         for op in _COLLECTIVES:
-            for marker in (f" {op}-start(", f" {op}("):
+            for marker, is_start in ((f" {op}-start(", True),
+                                     (f" {op}(", False)):
                 if marker in line and "=" in line.split(marker)[0]:
                     lhs = line.split(marker)[0].split("=", 1)[1]
                     rec = out.setdefault(op, {"count": 0, "payload_bytes": 0})
                     rec["count"] += 1
-                    rec["payload_bytes"] += _shape_bytes(lhs)
+                    rec["payload_bytes"] += (
+                        _async_result_bytes(lhs) if is_start
+                        else _shape_bytes(lhs)
+                    )
                     break
             else:
                 continue
@@ -90,10 +132,25 @@ def compile_and_audit(
     # Must run before any other jax use in this process (the container's
     # sitecustomize registers a TPU backend; see __graft_entry__).
     os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+    # Virtual-device fallback for jax builds without the
+    # ``jax_num_cpu_devices`` config option (e.g. 0.4.x): the XLA flag
+    # must be in the env BEFORE the backend initializes.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", n_devices)
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except AttributeError:
+        pass  # older jax: the XLA_FLAGS fallback above did the job
+    assert jax.device_count() == n_devices, (
+        f"virtual CPU mesh came up with {jax.device_count()} devices, "
+        f"wanted {n_devices}"
+    )
 
     import jax.numpy as jnp
     import numpy as np

@@ -1,100 +1,80 @@
 #!/usr/bin/env python
-"""Static watchdog-coverage audit: every thread/process spawn site in the
-package must register with the obs watchdog or say why it doesn't.
+"""Static watchdog-coverage audit — now a shim over the lint engine.
 
-The sibling of ``audit_collectives.py`` (which makes the scaling premise
-checkable, this makes the OBSERVABILITY premise checkable): the stall
-watchdog (obs/watchdog.py) only diagnoses components that heartbeat, so a
-new ``threading.Thread``/``mp.Process`` spawned without registering is a
-future "it hung and nothing says why" — exactly the hole ISSUE 3 closes.
-This audit walks the package AST and, for every spawn call, requires one
-of, within ``WINDOW`` lines of the spawn:
-
-- a ``watchdog.register(`` call (registration at the spawn site), or
-- a ``# watchdog:`` / ``# watchdog-exempt:`` comment with a non-empty
-  rationale (e.g. "registers in feeder() at thread start", "workers
-  heartbeat implicitly via the result queue").
+The original bespoke AST walk moved into the invariant lint engine as the
+``watchdog-coverage`` rule
+(``batchai_retinanet_horovod_coco_tpu/analysis/rules/watchdog_coverage.py``);
+this entry point survives so ``make lint-obs`` and the tier-1 wiring
+(tests/unit/test_obs.py, tests/unit/test_serve.py) keep their exact CLI and
+API: every thread/process spawn site in the package must register with the
+obs watchdog or say why it doesn't (a ``# watchdog: <why>`` rationale
+within ``WINDOW`` lines, or the engine's uniform
+``# lint: watchdog-coverage: <why>`` suppression).
 
 Run:
     python scripts/audit_threads.py            # audit the package, exit 1
     python scripts/audit_threads.py --json     # machine-readable report
 
-Wired into ``make lint-obs`` and run in tier-1
-(tests/unit/test_obs.py::test_audit_threads_clean).
+The full rule set (bounded queues, thread error contracts, jit purity,
+clock discipline, collective safety, this audit) runs via ``make lint`` /
+``python -m batchai_retinanet_horovod_coco_tpu.analysis``.
 """
 
 from __future__ import annotations
 
 import argparse
-import ast
 import json
 import os
-import re
 import sys
 
-# Constructors whose call sites spawn (or pool) concurrent execution.
-SPAWN_NAMES = frozenset(
-    {"Thread", "Timer", "Process", "ThreadPoolExecutor", "ProcessPoolExecutor"}
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # direct `python scripts/audit_threads.py` runs
+    sys.path.insert(0, _REPO)
+
+from batchai_retinanet_horovod_coco_tpu.analysis import engine  # noqa: E402
+from batchai_retinanet_horovod_coco_tpu.analysis.rules import (  # noqa: E402
+    watchdog_coverage as _rule,
 )
 
-# Lines around the spawn call searched for a registration or a rationale.
-WINDOW = 8
+# Legacy API surface, re-exported from the engine rule.
+SPAWN_NAMES = _rule.SPAWN_NAMES
+WINDOW = _rule.WINDOW
+_MARKER_RE = _rule.MARKER_RE
+_REGISTER_RE = _rule.REGISTER_RE
+_spawn_calls = _rule.spawn_calls
 
-_MARKER_RE = re.compile(r"#\s*watchdog(?:-exempt)?\s*(?:\((?P<scope>[^)]*)\))?:\s*(?P<why>\S.*)")
-_REGISTER_RE = re.compile(r"\bwatchdog\.register\(")
 
-
-def _spawn_calls(tree: ast.AST):
-    """Yield (lineno, callee_name) for every spawn-constructor call."""
-    for node in ast.walk(tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fn = node.func
-        name = None
-        if isinstance(fn, ast.Attribute):
-            name = fn.attr
-        elif isinstance(fn, ast.Name):
-            name = fn.id
-        if name in SPAWN_NAMES:
-            yield node.lineno, name
+def _to_legacy(finding: engine.Finding, path: str) -> dict:
+    if finding.rule == engine.SUPPRESSION_RULE:
+        return {"path": path, "line": finding.line, "callee": "?",
+                "reason": finding.message.replace("unparseable file: ",
+                                                  "unparseable: ")}
+    return {
+        "path": path,
+        "line": finding.line,
+        "callee": finding.message.split("(", 1)[0],
+        "reason": finding.message,
+    }
 
 
 def audit_file(path: str) -> list[dict]:
     """Violations in one file: spawn sites with neither a nearby
-    ``watchdog.register(`` nor a ``# watchdog...:`` rationale comment."""
+    ``watchdog.register(`` nor a rationale (legacy ``# watchdog...:``
+    marker or engine ``# lint: watchdog-coverage: <why>``)."""
     with open(path) as f:
         src = f.read()
-    try:
-        tree = ast.parse(src, filename=path)
-    except SyntaxError as e:
-        return [{"path": path, "line": e.lineno or 0,
-                 "callee": "?", "reason": f"unparseable: {e.msg}"}]
-    lines = src.splitlines()
-    violations = []
-    for lineno, callee in _spawn_calls(tree):
-        lo = max(0, lineno - 1 - WINDOW)
-        hi = min(len(lines), lineno + WINDOW)
-        window = "\n".join(lines[lo:hi])
-        if _REGISTER_RE.search(window) or _MARKER_RE.search(window):
-            continue
-        violations.append(
-            {
-                "path": path,
-                "line": lineno,
-                "callee": callee,
-                "reason": (
-                    f"{callee}() spawn without watchdog.register( or a "
-                    "'# watchdog: <why>' rationale within "
-                    f"{WINDOW} lines"
-                ),
-            }
-        )
-    return violations
+    res = engine.lint_source(path, path, src, rule_names=[_rule.NAME])
+    out = [_to_legacy(f, path) for f in res.findings]
+    # Suppression-grammar errors in the file still surface here so a typo'd
+    # rationale can't silently waive the audit.
+    out.extend(_to_legacy(f, path) for f in res.grammar_findings)
+    return out
 
 
 def audit_package(root: str) -> list[dict]:
     violations = []
-    for dirpath, _dirnames, filenames in os.walk(root):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
         for fn in sorted(filenames):
             if fn.endswith(".py"):
                 violations.extend(audit_file(os.path.join(dirpath, fn)))
@@ -102,11 +82,7 @@ def audit_package(root: str) -> list[dict]:
 
 
 def default_root() -> str:
-    return os.path.join(
-        os.path.dirname(os.path.abspath(__file__)),
-        "..",
-        "batchai_retinanet_horovod_coco_tpu",
-    )
+    return os.path.join(_REPO, "batchai_retinanet_horovod_coco_tpu")
 
 
 def main() -> int:

@@ -21,7 +21,8 @@ CLUSTER = python -m batchai_retinanet_horovod_coco_tpu.launch.cluster
 .PHONY: create submit status delete test test-timings smoke bench \
 	bench-check bench-pipeline pipebench pipebench-check evalbench \
 	evalbench-check servebench servebench-check canaries \
-	convergence-full lint lint-obs check-static
+	convergence-full lint lint-obs check-static tune-smoke tunebench \
+	tunebench-check
 
 create:
 	$(CLUSTER) create --name $(NAME) --zone $(ZONE) --accelerator $(ACCEL) $(DRYFLAG)
@@ -124,6 +125,33 @@ check-static: lint
 # in tier-1 (tests/unit/test_obs.py::test_audit_threads_clean).
 lint-obs:
 	python scripts/audit_threads.py
+
+# Schedule autotuner (ISSUE 6, tune/): measured search over the tunable
+# hot-path parameters — Pallas tile/block shapes (focal, matching, NMS),
+# pre_nms_size, per-bucket batch sizes — per device_kind; winners land in
+# artifacts/schedules/<device_kind>.json, which train/eval/serve/export
+# resolve at compile time (RUNBOOK "Autotuning schedules").
+#
+# tune-smoke: CPU-sized end-to-end proof (tiny bucket, xla winners,
+# pallas candidates recorded as skipped) into a throwaway registry dir —
+# CI-safe, never mutates the committed registry.
+tune-smoke:
+	python -m batchai_retinanet_horovod_coco_tpu.tune --smoke \
+	  --ops nms,focal,matching --batch-axis \
+	  --out-root /tmp/tune_smoke_schedules
+
+# tunebench: the real search on THIS device (probe + exit-75 outage
+# contract) — writes the device's registry artifact AND the committed
+# TUNEBENCH.json tripwire record (the NMS winner's measured ms/batch).
+tunebench:
+	python -m batchai_retinanet_horovod_coco_tpu.tune --batch-axis \
+	  --bench-out TUNEBENCH.json
+
+# tunebench-check: re-measure the committed TUNEBENCH winner and enforce
+# the +3% ms ceiling — same device-class guard as bench-check (a record
+# captured on another device class passes with a loud re-capture note).
+tunebench-check:
+	python -m batchai_retinanet_horovod_coco_tpu.tune --check
 
 # Host input-pipeline bench: threads-vs-procs sweep (bench_pipeline.py).
 # pipebench-check is the regression tripwire twin of bench-check: measured

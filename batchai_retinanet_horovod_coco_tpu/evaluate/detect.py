@@ -65,14 +65,123 @@ from batchai_retinanet_horovod_coco_tpu.train.state import model_variables
 
 @dataclasses.dataclass(frozen=True)
 class DetectConfig:
-    """FilterDetections-equivalent knobs (reference defaults, SURVEY.md M6)."""
+    """FilterDetections-equivalent knobs (reference defaults, SURVEY.md M6).
+
+    Since ISSUE 6 the performance knobs — ``pre_nms_size``, the NMS
+    backend, and its block shape — are SCHEDULE-RESOLVED: ``None`` means
+    "look the winner up in the per-device schedule registry"
+    (tune/schedule.py; the built-in defaults reproduce the hand-picked
+    values every consumer shipped with).  An explicit value pins the knob
+    regardless of the registry.  Resolution happens once per compile in
+    :func:`resolve_detect_config` — the registry lookup is cached and
+    stable for the process lifetime, so serve/eval never recompile at
+    request time.
+    """
 
     score_threshold: float = 0.05
     iou_threshold: float = 0.5
-    pre_nms_size: int = 1000
+    # None = schedule-resolved (built-in default 1000).  NOTE: unlike the
+    # backend knobs below, this one CHANGES DETECTION SEMANTICS (fewer
+    # candidates survive to NMS) — see tune/candidates.py.
+    pre_nms_size: int | None = None
     max_detections: int = 300
+    # NMS suppression backend: None = schedule-resolved ("xla" unless the
+    # device's committed schedule names "pallas"); "xla" | "pallas" pins.
+    nms_impl: str | None = None
+    # (K, K) IoU tile width of the Pallas kernel: None = schedule-resolved.
+    nms_block_k: int | None = None
+    # Interpreter-mode Pallas (CPU tests of the fused suppression path).
+    nms_interpret: bool = False
     codec: boxes_lib.BoxCodecConfig = boxes_lib.BoxCodecConfig()
     anchor: anchors_lib.AnchorConfig = anchors_lib.AnchorConfig()
+
+
+def resolve_detect_config(
+    config: DetectConfig, device_kind: str | None = None
+) -> DetectConfig:
+    """Fill every schedule-resolved field; returns a fully concrete config.
+
+    The consumer entrypoint for the tune/ registry on the detect side:
+    ``_detect_body`` calls it at trace time (host-side, once per bucket
+    compile), so the executable bakes the winning ``pre_nms_size`` /
+    backend / block shape in.  Unknown ``device_kind`` falls back to the
+    built-in defaults with one loud ``schedule_fallback`` event
+    (tune/schedule.py), never a crash.
+    """
+    if config.nms_impl is not None and config.nms_impl not in ("xla", "pallas"):
+        # Validate BEFORE the fully-pinned early return: a typo'd impl on
+        # a fully concrete config must raise here, not silently take the
+        # XLA branch in nms_fn_for's == "pallas" comparison.
+        raise ValueError(
+            f"nms_impl must be 'xla' or 'pallas', got {config.nms_impl!r}"
+        )
+    if (
+        config.pre_nms_size is not None
+        and config.nms_impl is not None
+        and config.nms_block_k is not None
+    ):
+        return config
+    from batchai_retinanet_horovod_coco_tpu.tune import schedule as schedule_lib
+
+    entry = schedule_lib.lookup(device_kind)["nms"]
+    impl = config.nms_impl or str(entry.get("impl", "xla"))
+    if impl == "auto":  # NMS has no backend-conditional default: auto = xla
+        impl = "xla"
+    if impl not in ("xla", "pallas"):
+        raise ValueError(f"nms_impl must be 'xla' or 'pallas', got {impl!r}")
+    return dataclasses.replace(
+        config,
+        pre_nms_size=(
+            config.pre_nms_size
+            if config.pre_nms_size is not None
+            else int(entry.get("pre_nms_size", 1000))
+        ),
+        nms_impl=impl,
+        nms_block_k=(
+            config.nms_block_k
+            if config.nms_block_k is not None
+            else int(entry.get("block_k", 256))
+        ),
+    )
+
+
+def nms_fn_for(
+    config: DetectConfig,
+) -> Callable[[jnp.ndarray, jnp.ndarray], nms_lib.Detections]:
+    """``(boxes (B, A, 4), scores (B, A, K)) → Detections`` for a RESOLVED
+    config — the one place the XLA-vs-Pallas suppression dispatch lives
+    (bench.py's postprocess tripwire uses it too, so the tuned winner is
+    what the committed number measures)."""
+    config = resolve_detect_config(config)
+    if config.nms_impl == "pallas":
+        from batchai_retinanet_horovod_coco_tpu.ops.pallas import (
+            nms as pallas_nms,
+        )
+
+        def nms(boxes, scores):
+            return pallas_nms.batched_multiclass_nms_pallas(
+                boxes,
+                scores,
+                score_threshold=config.score_threshold,
+                iou_threshold=config.iou_threshold,
+                pre_nms_size=config.pre_nms_size,
+                max_detections=config.max_detections,
+                block_k=config.nms_block_k,
+                interpret=config.nms_interpret,
+            )
+    else:
+
+        def nms(boxes, scores):
+            return nms_lib.batched_multiclass_nms(
+                boxes,
+                scores,
+                score_threshold=config.score_threshold,
+                iou_threshold=config.iou_threshold,
+                pre_nms_size=config.pre_nms_size,
+                max_detections=config.max_detections,
+            )
+
+    return nms
 
 
 def _detect_body(
@@ -80,10 +189,18 @@ def _detect_body(
 ) -> Callable[[Any, jnp.ndarray], nms_lib.Detections]:
     """The ONE detection pipeline every factory wraps: normalize → forward →
     sigmoid → decode → clip → batched NMS.  Shared so the batch-sharded and
-    spatially-sharded paths can never drift from the single-device one."""
+    spatially-sharded paths can never drift from the single-device one.
+
+    The NMS backend dispatch lives here too (schedule-resolved, see
+    :func:`resolve_detect_config`): ``impl == "pallas"`` swaps the
+    suppression stage for the fused blocked kernel (ops/pallas/nms.py),
+    which shares candidate selection and compaction with the XLA path and
+    is bit-identical to it (tests/unit/test_pallas_nms.py)."""
+    config = resolve_detect_config(config)
     anchors = jnp.asarray(
         anchors_lib.anchors_for_image_shape(image_hw, config.anchor)
     )
+    nms = nms_fn_for(config)
 
     def detect(state, images: jnp.ndarray) -> nms_lib.Detections:
         # uint8 batches normalize on device (data/pipeline.normalize_images).
@@ -94,14 +211,7 @@ def _detect_body(
             anchors[None], outputs["box_deltas"], config.codec
         )
         boxes = boxes_lib.clip_boxes(boxes, image_hw)
-        return nms_lib.batched_multiclass_nms(
-            boxes,
-            scores,
-            score_threshold=config.score_threshold,
-            iou_threshold=config.iou_threshold,
-            pre_nms_size=config.pre_nms_size,
-            max_detections=config.max_detections,
-        )
+        return nms(boxes, scores)
 
     return detect
 

@@ -54,6 +54,11 @@ class MatchingConfig:
     fused_pallas: bool | None = None
     # Interpreter-mode pallas (CPU tests of the fused path).
     pallas_interpret: bool = False
+    # Anchor-tile width for the fused kernel: None = the schedule-resolved
+    # or module default (ops/pallas/matching.TILE_A).  A searched schedule
+    # parameter — train/step.py fills it from the per-device registry
+    # (tune/schedule.py) when left None.
+    pallas_tile_a: int | None = None
 
 
 class AnchorAssignment(NamedTuple):
@@ -257,6 +262,7 @@ def anchor_targets_compact_batched(
             anchors, gt_boxes, gt_labels, gt_mask,
             interpret=matching.pallas_interpret,
             planar=planar_box_targets,
+            tile_a=matching.pallas_tile_a,
         )
     )
     num_anchors = anchors.shape[0]

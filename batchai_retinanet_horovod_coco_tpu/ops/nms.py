@@ -19,6 +19,16 @@ classic class-offset trick's f32 precision loss (offsetting by
 ~0.06 px and borderline IoU-vs-threshold decisions can flip).
 
 Everything vmaps over a leading batch axis.
+
+Since ISSUE 6 the pipeline's three stages are exposed as named functions —
+:func:`select_candidates` (threshold + two-stage top-K),
+:func:`greedy_keep` (the exact fixed-point suppression over sorted
+candidates) and :func:`compact_keep`/:func:`build_detections` (fixed-width
+output) — because the fused Pallas suppression kernel
+(ops/pallas/nms.py) shares stages 1 and 3 verbatim and replaces only
+stage 2.  Sharing the code, not cloning it, is what makes the two
+backends' bit-identity (tests/unit/test_pallas_nms.py) structural rather
+than coincidental.
 """
 
 from __future__ import annotations
@@ -39,6 +49,78 @@ class Detections(NamedTuple):
     scores: jnp.ndarray  # (max_detections,)
     labels: jnp.ndarray  # (max_detections,) int32
     valid: jnp.ndarray  # (max_detections,) bool
+
+
+def greedy_keep(
+    sorted_boxes: jnp.ndarray,
+    sorted_scores: jnp.ndarray,
+    iou_threshold: float,
+    sorted_class_ids: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Exact greedy-NMS keep mask over boxes ALREADY in descending-score
+    order: keep_i ⇔ valid_i ∧ ¬∃ kept j < i with IoU > t (same class).
+
+    EXACT greedy NMS by fixed-point iteration instead of an N-step
+    sequential loop: iterating that map from all-valid stabilizes
+    front-to-back in score order (position i becomes final once all j < i
+    are final), so it converges to the unique greedy solution in
+    "suppression chain depth" iterations — typically < 10 — and each
+    iteration is one vectorized (N, N) masked any-reduce.  The naive
+    N-step fori_loop was pure sequential latency on TPU: ~425 ms of a
+    475 ms eval batch at N=1000, B=8; this form measures in single-digit
+    ms.  Entries with score ≤ _NEG_INF/2 are padding (never kept, never
+    suppressing).
+
+    This is the stage the Pallas suppression kernel (ops/pallas/nms.py)
+    replaces; it doubles as that kernel's pure-jnp fallback and parity
+    oracle.
+    """
+    n = sorted_boxes.shape[0]
+    iou = pairwise_iou(sorted_boxes, sorted_boxes)  # (N, N)
+    if sorted_class_ids is not None:
+        iou = jnp.where(
+            sorted_class_ids[:, None] == sorted_class_ids[None, :], iou, 0.0
+        )
+    valid0 = sorted_scores > _NEG_INF / 2  # drop padding
+    suppressor = (iou > iou_threshold) & (
+        jnp.arange(n)[:, None] < jnp.arange(n)[None, :]
+    )  # [j, i]: higher-scored j would suppress i if j is kept
+
+    def cond(carry):
+        keep, prev, it = carry
+        return jnp.any(keep != prev) & (it < n)
+
+    def body(carry):
+        keep, _, it = carry
+        suppressed = jnp.any(suppressor & keep[:, None], axis=0)
+        return valid0 & ~suppressed, keep, it + 1
+
+    keep, _, _ = lax.while_loop(
+        cond, body, (valid0, jnp.zeros_like(valid0), jnp.int32(0))
+    )
+    return keep
+
+
+def compact_keep(
+    sorted_scores: jnp.ndarray, keep: jnp.ndarray, max_output: int
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Compact kept slots to the front, preserving score order.
+
+    Returns ``(sel, valid)`` of shape (max_output,): indices into the
+    sorted candidate order with ``valid`` False for suppressed/padded
+    slots.  If fewer candidates than ``max_output`` exist, pads with
+    invalid slots.
+    """
+    n = sorted_scores.shape[0]
+    kept_scores = jnp.where(keep, sorted_scores, _NEG_INF)
+    k = min(max_output, n)
+    _, sel = lax.top_k(kept_scores, k)
+    valid = kept_scores[sel] > _NEG_INF / 2
+    if k < max_output:
+        pad = max_output - k
+        sel = jnp.concatenate([sel, jnp.zeros(pad, dtype=sel.dtype)])
+        valid = jnp.concatenate([valid, jnp.zeros(pad, dtype=bool)])
+    return sel, valid
 
 
 def single_class_nms(
@@ -62,50 +144,61 @@ def single_class_nms(
     n = boxes.shape[0]
     order_scores, order = lax.top_k(scores, n)  # full sort by score
     sorted_boxes = boxes[order]
+    sorted_cls = class_ids[order] if class_ids is not None else None
 
-    iou = pairwise_iou(sorted_boxes, sorted_boxes)  # (N, N)
-    if class_ids is not None:
-        sorted_cls = class_ids[order]
-        iou = jnp.where(sorted_cls[:, None] == sorted_cls[None, :], iou, 0.0)
-
-    # EXACT greedy NMS by fixed-point iteration instead of an N-step
-    # sequential loop: keep_i ⇔ valid_i ∧ ¬∃ higher-scored KEPT j with
-    # IoU > t.  Iterating that map from all-valid stabilizes front-to-back
-    # in score order (position i becomes final once all j < i are final),
-    # so it converges to the unique greedy solution in "suppression chain
-    # depth" iterations — typically < 10 — and each iteration is one
-    # vectorized (N, N) masked any-reduce.  The naive N-step fori_loop was
-    # pure sequential latency on TPU: ~425 ms of a 475 ms eval batch at
-    # N=1000, B=8; this form measures in single-digit ms.
-    valid0 = order_scores > _NEG_INF / 2  # drop padding
-    suppressor = (iou > iou_threshold) & (
-        jnp.arange(n)[:, None] < jnp.arange(n)[None, :]
-    )  # [j, i]: higher-scored j would suppress i if j is kept
-
-    def cond(carry):
-        keep, prev, it = carry
-        return jnp.any(keep != prev) & (it < n)
-
-    def body(carry):
-        keep, _, it = carry
-        suppressed = jnp.any(suppressor & keep[:, None], axis=0)
-        return valid0 & ~suppressed, keep, it + 1
-
-    keep, _, _ = lax.while_loop(
-        cond, body, (valid0, jnp.zeros_like(valid0), jnp.int32(0))
-    )
-
-    # Compact kept indices to the front, preserving score order.  If fewer
-    # candidates than max_output exist, pad with invalid slots.
-    kept_scores = jnp.where(keep, order_scores, _NEG_INF)
-    k = min(max_output, n)
-    _, sel = lax.top_k(kept_scores, k)
-    valid = kept_scores[sel] > _NEG_INF / 2
-    if k < max_output:
-        pad = max_output - k
-        sel = jnp.concatenate([sel, jnp.zeros(pad, dtype=sel.dtype)])
-        valid = jnp.concatenate([valid, jnp.zeros(pad, dtype=bool)])
+    keep = greedy_keep(sorted_boxes, order_scores, iou_threshold, sorted_cls)
+    sel, valid = compact_keep(order_scores, keep, max_output)
     return order[sel], valid
+
+
+def select_candidates(
+    boxes: jnp.ndarray,
+    cls_scores: jnp.ndarray,
+    score_threshold: float,
+    pre_nms_size: int,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Score threshold + two-stage top-K pre-selection (one image).
+
+    Two-stage candidate selection: top anchors by their best class score,
+    then top (anchor, class) pairs within those rows.  A direct
+    lax.top_k over the (A*K,) flat scores lowers to a full variadic sort
+    on TPU — measured 394 ms of a 470 ms eval batch at the flagship
+    bucket (B=8, A*K=16.1M); this form measures ~12 ms for the same
+    batch.  EXACT up to score ties: with ka = k, every pair of a dropped
+    anchor scores below that anchor's best, which scores below all ka
+    selected anchors' bests — k of which are already candidate pairs —
+    so the selected score multiset equals the global top-k's.
+
+    Returns ``(cand_boxes (k, 4), cand_scores (k,) DESCENDING, class_idx
+    (k,) int32)``; sub-threshold slots carry score ``_NEG_INF``.  Shared
+    by the XLA and Pallas NMS paths.
+    """
+    num_anchors, num_classes = cls_scores.shape
+    masked = jnp.where(cls_scores > score_threshold, cls_scores, _NEG_INF)
+    ka = min(pre_nms_size, num_anchors)
+    _, top_anchor = lax.top_k(jnp.max(masked, axis=-1), ka)  # (ka,)
+    rows = masked[top_anchor]  # (ka, K) — small gather
+    k = min(pre_nms_size, ka * num_classes)
+    top_scores, flat_i = lax.top_k(rows.reshape(-1), k)
+    anchor_idx = top_anchor[flat_i // num_classes]
+    class_idx = (flat_i % num_classes).astype(jnp.int32)
+    return boxes[anchor_idx], top_scores, class_idx
+
+
+def build_detections(
+    cand_boxes: jnp.ndarray,
+    cand_scores: jnp.ndarray,
+    class_idx: jnp.ndarray,
+    sel: jnp.ndarray,
+    valid: jnp.ndarray,
+) -> Detections:
+    """Fixed-width Detections from candidates + a compacted selection."""
+    return Detections(
+        boxes=jnp.where(valid[:, None], cand_boxes[sel], 0.0),
+        scores=jnp.where(valid, cand_scores[sel], _NEG_INF),
+        labels=jnp.where(valid, class_idx[sel], -1),
+        valid=valid,
+    )
 
 
 def multiclass_nms(
@@ -124,27 +217,9 @@ def multiclass_nms(
     per-class isolation comes from the class-masked suppressor in
     :func:`single_class_nms`, which is exact at any coordinate scale.
     """
-    num_anchors, num_classes = cls_scores.shape
-    masked = jnp.where(cls_scores > score_threshold, cls_scores, _NEG_INF)
-
-    # Two-stage candidate selection: top anchors by their best class score,
-    # then top (anchor, class) pairs within those rows.  A direct
-    # lax.top_k over the (A*K,) flat scores lowers to a full variadic sort
-    # on TPU — measured 394 ms of a 470 ms eval batch at the flagship
-    # bucket (B=8, A*K=16.1M); this form measures ~12 ms for the same
-    # batch.  EXACT up to score ties: with ka = k, every pair of a dropped
-    # anchor scores below that anchor's best, which scores below all ka
-    # selected anchors' bests — k of which are already candidate pairs —
-    # so the selected score multiset equals the global top-k's.
-    ka = min(pre_nms_size, num_anchors)
-    _, top_anchor = lax.top_k(jnp.max(masked, axis=-1), ka)  # (ka,)
-    rows = masked[top_anchor]  # (ka, K) — small gather
-    k = min(pre_nms_size, ka * num_classes)
-    top_scores, flat_i = lax.top_k(rows.reshape(-1), k)
-    anchor_idx = top_anchor[flat_i // num_classes]
-    class_idx = (flat_i % num_classes).astype(jnp.int32)
-
-    cand_boxes = boxes[anchor_idx]  # (k, 4)
+    cand_boxes, top_scores, class_idx = select_candidates(
+        boxes, cls_scores, score_threshold, pre_nms_size
+    )
     sel, valid = single_class_nms(
         cand_boxes,
         top_scores,
@@ -152,12 +227,7 @@ def multiclass_nms(
         max_output=max_detections,
         class_ids=class_idx,
     )
-    return Detections(
-        boxes=jnp.where(valid[:, None], cand_boxes[sel], 0.0),
-        scores=jnp.where(valid, top_scores[sel], _NEG_INF),
-        labels=jnp.where(valid, class_idx[sel], -1),
-        valid=valid,
-    )
+    return build_detections(cand_boxes, top_scores, class_idx, sel, valid)
 
 
 def batched_multiclass_nms(

@@ -12,5 +12,12 @@ from batchai_retinanet_horovod_coco_tpu.ops.pallas.focal import (
 from batchai_retinanet_horovod_coco_tpu.ops.pallas.matching import (
     assign_fused,
 )
+from batchai_retinanet_horovod_coco_tpu.ops.pallas.nms import (
+    batched_multiclass_nms_pallas,
+)
 
-__all__ = ["assign_fused", "focal_loss_per_image_sums"]
+__all__ = [
+    "assign_fused",
+    "batched_multiclass_nms_pallas",
+    "focal_loss_per_image_sums",
+]

@@ -133,19 +133,23 @@ def _row_spec(tile_a):
     )
 
 
-def _call_fwd(cls_logits, matched_labels, anchor_state, alpha, gamma, interpret):
+def _call_fwd(
+    cls_logits, matched_labels, anchor_state, alpha, gamma, interpret,
+    tile_a=None,
+):
+    tile = FWD_TILE_A if tile_a is None else int(tile_a)
     batch, num_anchors, _ = cls_logits.shape
-    grid = (batch, pl.cdiv(num_anchors, FWD_TILE_A))
+    grid = (batch, pl.cdiv(num_anchors, tile))
     out = pl.pallas_call(
         functools.partial(
             _fwd_kernel, alpha=alpha, gamma=gamma, num_anchors=num_anchors
         ),
         grid=grid,
         in_specs=[
-            _row_spec(FWD_TILE_A),
-            _row_spec(FWD_TILE_A),
+            _row_spec(tile),
+            _row_spec(tile),
             pl.BlockSpec(
-                (1, FWD_TILE_A, cls_logits.shape[-1]),
+                (1, tile, cls_logits.shape[-1]),
                 lambda b, t: (b, t, 0),
                 memory_space=pltpu.VMEM,
             ),
@@ -169,19 +173,23 @@ def _call_fwd(cls_logits, matched_labels, anchor_state, alpha, gamma, interpret)
     return out[:, 0, 0]
 
 
-def _call_bwd(cls_logits, matched_labels, anchor_state, g, alpha, gamma, interpret):
+def _call_bwd(
+    cls_logits, matched_labels, anchor_state, g, alpha, gamma, interpret,
+    tile_a=None,
+):
+    tile = BWD_TILE_A if tile_a is None else int(tile_a)
     batch, num_anchors, _ = cls_logits.shape
-    grid = (batch, pl.cdiv(num_anchors, BWD_TILE_A))
+    grid = (batch, pl.cdiv(num_anchors, tile))
     return pl.pallas_call(
         functools.partial(
             _bwd_kernel, alpha=alpha, gamma=gamma, num_anchors=num_anchors
         ),
         grid=grid,
         in_specs=[
-            _row_spec(BWD_TILE_A),
-            _row_spec(BWD_TILE_A),
+            _row_spec(tile),
+            _row_spec(tile),
             pl.BlockSpec(
-                (1, BWD_TILE_A, cls_logits.shape[-1]),
+                (1, tile, cls_logits.shape[-1]),
                 lambda b, t: (b, t, 0),
                 memory_space=pltpu.VMEM,
             ),
@@ -190,7 +198,7 @@ def _call_bwd(cls_logits, matched_labels, anchor_state, g, alpha, gamma, interpr
             ),
         ],
         out_specs=pl.BlockSpec(
-            (1, BWD_TILE_A, cls_logits.shape[-1]),
+            (1, tile, cls_logits.shape[-1]),
             lambda b, t: (b, t, 0),
             memory_space=pltpu.VMEM,
         ),
@@ -211,7 +219,7 @@ def _call_bwd(cls_logits, matched_labels, anchor_state, g, alpha, gamma, interpr
     )
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def focal_loss_per_image_sums(
     cls_logits: jnp.ndarray,
     matched_labels: jnp.ndarray,
@@ -219,6 +227,8 @@ def focal_loss_per_image_sums(
     alpha: float = 0.25,
     gamma: float = 2.0,
     interpret: bool = False,
+    fwd_tile_a: int | None = None,
+    bwd_tile_a: int | None = None,
 ) -> jnp.ndarray:
     """Per-image focal-loss sums (B,) over non-ignored anchors, fused on TPU.
 
@@ -227,23 +237,38 @@ def focal_loss_per_image_sums(
       matched_labels: (B, A) int32 matched class ids (read where positive).
       anchor_state: (B, A) int32 in {-1 ignore, 0 negative, 1 positive}.
       interpret: run the kernel in interpreter mode (CPU testing).
+      fwd_tile_a / bwd_tile_a: anchor-tile widths (None = the module
+        defaults FWD_TILE_A/BWD_TILE_A).  Searched schedule parameters
+        (tune/candidates.FOCAL_FWD_TILES/FOCAL_BWD_TILES) — must be
+        positive multiples of 128; the backward ceiling is lower because
+        it holds more live temps (see the constants' note above).
 
     Gradients flow to ``cls_logits`` only.
     """
     return _call_fwd(
-        cls_logits, matched_labels, anchor_state, alpha, gamma, interpret
+        cls_logits, matched_labels, anchor_state, alpha, gamma, interpret,
+        fwd_tile_a,
     )
 
 
-def _vjp_fwd(cls_logits, matched_labels, anchor_state, alpha, gamma, interpret):
-    out = _call_fwd(cls_logits, matched_labels, anchor_state, alpha, gamma, interpret)
+def _vjp_fwd(
+    cls_logits, matched_labels, anchor_state, alpha, gamma, interpret,
+    fwd_tile_a, bwd_tile_a,
+):
+    out = _call_fwd(
+        cls_logits, matched_labels, anchor_state, alpha, gamma, interpret,
+        fwd_tile_a,
+    )
     return out, (cls_logits, matched_labels, anchor_state)
 
 
-def _vjp_bwd(alpha, gamma, interpret, residuals, g):
+def _vjp_bwd(
+    alpha, gamma, interpret, fwd_tile_a, bwd_tile_a, residuals, g
+):
     cls_logits, matched_labels, anchor_state = residuals
     dx = _call_bwd(
-        cls_logits, matched_labels, anchor_state, g, alpha, gamma, interpret
+        cls_logits, matched_labels, anchor_state, g, alpha, gamma, interpret,
+        bwd_tile_a,
     )
     return dx, None, None
 

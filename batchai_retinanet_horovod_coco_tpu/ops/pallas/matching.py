@@ -116,7 +116,9 @@ def _kernel(anchors_ref, gt_ref, packedT_ref, out_ref, gtbest_ref, *, num_anchor
         gtbest_ref[0] = jnp.where(better, update, cur)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "planar"))
+@functools.partial(
+    jax.jit, static_argnames=("interpret", "planar", "tile_a")
+)
 def assign_fused(
     anchors: jnp.ndarray,
     gt_boxes: jnp.ndarray,
@@ -124,6 +126,7 @@ def assign_fused(
     gt_mask: jnp.ndarray,
     interpret: bool = False,
     planar: bool = False,
+    tile_a: int | None = None,
 ):
     """Batched fused assignment.
 
@@ -136,12 +139,16 @@ def assign_fused(
         slice of the kernel's transposed output, where the default (B, A, 4)
         form costs a moveaxis copy of a 32x-lane-padded tensor (~206 MB of
         tiles at the flagship bucket; see ops.boxes.encode_boxes_planar).
+      tile_a: anchor-tile width (None = module default TILE_A).  A searched
+        schedule parameter (tune/candidates.MATCHING_TILES); must be a
+        positive multiple of 128.
 
     Returns:
       matched_boxes (B, A, 4) f32 — or (B, 4, A) when ``planar`` —
       matched_labels (B, A) int32, max_iou (B, A) f32, gt_best_iou (B, G)
       f32, gt_best_anchor (B, G) int32.
     """
+    tile = TILE_A if tile_a is None else int(tile_a)
     batch, num_gt, _ = gt_boxes.shape
     num_anchors = anchors.shape[0]
     boxes = gt_boxes.astype(jnp.float32)
@@ -166,12 +173,12 @@ def assign_fused(
         axis=1,
     )  # (B, 8, G)
 
-    grid = (batch, pl.cdiv(num_anchors, TILE_A))
+    grid = (batch, pl.cdiv(num_anchors, tile))
     out, gtbest = pl.pallas_call(
         functools.partial(_kernel, num_anchors=num_anchors),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((4, TILE_A), lambda b, t: (0, t),
+            pl.BlockSpec((4, tile), lambda b, t: (0, t),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, num_gt, 6), lambda b, t: (b, 0, 0),
                          memory_space=pltpu.VMEM),
@@ -179,7 +186,7 @@ def assign_fused(
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, 8, TILE_A), lambda b, t: (b, 0, t),
+            pl.BlockSpec((1, 8, tile), lambda b, t: (b, 0, t),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, num_gt, 8), lambda b, t: (b, 0, 0),
                          memory_space=pltpu.VMEM),

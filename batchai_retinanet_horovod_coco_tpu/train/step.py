@@ -110,6 +110,52 @@ def _forward_and_loss(
     return metrics["loss"], (metrics, new_batch_stats)
 
 
+def resolve_kernel_schedule(
+    loss_config: losses_lib.LossConfig,
+    matching_config: matching_lib.MatchingConfig,
+    device_kind: str | None = None,
+) -> tuple[losses_lib.LossConfig, matching_lib.MatchingConfig]:
+    """Fill schedule-resolved kernel params (the train-side consumer of
+    the tune/ registry): focal impl + fwd/bwd tiles, matching impl + tile.
+
+    ``None`` fields mean "look the measured winner up in the per-device
+    schedule" (tune/schedule.py; built-in defaults reproduce the
+    hand-picked values, so an untuned device behaves exactly as before
+    ISSUE 6).  Explicit values always win — a CLI/test override must not
+    be silently re-tuned.  ``matching.impl == "auto"`` preserves the
+    backend-conditional dispatch (fused on TPU, jnp elsewhere).
+    """
+    import dataclasses as _dc
+
+    from batchai_retinanet_horovod_coco_tpu.tune import (
+        schedule as schedule_lib,
+    )
+
+    sched = schedule_lib.lookup(device_kind)
+    m, f = sched["matching"], sched["focal"]
+    if matching_config.pallas_tile_a is None:
+        matching_config = _dc.replace(
+            matching_config, pallas_tile_a=int(m["tile_a"])
+        )
+    if matching_config.fused_pallas is None and m["impl"] != "auto":
+        matching_config = _dc.replace(
+            matching_config, fused_pallas=m["impl"] == "pallas"
+        )
+    if loss_config.pallas_focal is None and f["impl"] != "auto":
+        loss_config = _dc.replace(
+            loss_config, pallas_focal=f["impl"] == "pallas"
+        )
+    if loss_config.focal_fwd_tile_a is None:
+        loss_config = _dc.replace(
+            loss_config, focal_fwd_tile_a=int(f["fwd_tile_a"])
+        )
+    if loss_config.focal_bwd_tile_a is None:
+        loss_config = _dc.replace(
+            loss_config, focal_bwd_tile_a=int(f["bwd_tile_a"])
+        )
+    return loss_config, matching_config
+
+
 def _make_local_step(model, anchors, loss_config, matching_config):
     """The per-shard (or single-device) grad computation every step shares."""
 
@@ -208,6 +254,11 @@ def make_train_step(
         anchors_lib.anchors_for_image_shape(image_hw, anchor_config or anchors_lib.AnchorConfig())
     )
 
+    # Schedule-resolved kernel params (tune/): tile shapes + impl choices
+    # come from the per-device registry unless explicitly pinned.
+    loss_config, matching_config = resolve_kernel_schedule(
+        loss_config, matching_config
+    )
     local_step = _make_local_step(model, anchors, loss_config, matching_config)
 
     if mesh is None:
@@ -554,6 +605,14 @@ def make_train_step_spatial(
             "pallas_call is opaque to GSPMD, so the head outputs would be "
             "replicated instead of sharded — use the default XLA focal path"
         )
+    # Resolve the schedule first (tile fields), then FORCE the GSPMD-opaque
+    # kernels off: a per-device schedule winner must not re-enable what
+    # spatial partitioning cannot shard (only an EXPLICIT pallas_focal=True
+    # reaches the raise above).
+    loss_config, matching_config = resolve_kernel_schedule(
+        loss_config, matching_config
+    )
+    loss_config = _dc.replace(loss_config, pallas_focal=False)
     matching_config = _dc.replace(matching_config, fused_pallas=False)
     anchors = jnp.asarray(
         anchors_lib.anchors_for_image_shape(

@@ -1,0 +1,324 @@
+"""Schedule-search CLI: ``python -m batchai_retinanet_horovod_coco_tpu.tune``.
+
+Three jobs, one command (RUNBOOK "Autotuning schedules"):
+
+- **search** (default): measure candidates for the requested ops on THIS
+  process's device, compose the winners + full trial log into a
+  schema-valid artifact, and save it to the per-device registry
+  (``artifacts/schedules/<device_kind>.json``) — consumers pick it up on
+  their next process start.  ``--dry-run`` prints without writing.
+- **--bench-out TUNEBENCH.json**: additionally commit a regression
+  tripwire record (the NMS winner's measured ms/batch), the tune/ twin of
+  BUCKETBENCH/EVALBENCH/SERVEBENCH.
+- **--check**: re-measure the committed TUNEBENCH winner and enforce the
+  noise band (``make tunebench-check``) — same device-class guard as
+  bench-check: a record captured on a different device class passes with
+  a loud re-capture note instead of failing the run.
+
+Outage contract is bench.py's, reused directly: subprocess probe before
+any in-process device work, UNAVAILABLE-class errors in any phase emit
+ONE structured JSON line with the committed last-known-good attached and
+exit 75 (EX_TEMPFAIL) — never an rc-1 traceback.  ``--smoke`` skips the
+probe (CPU path, ``make tune-smoke``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# 3% noise band, bench.py's tripwire policy (TUNEBENCH measures ms/batch,
+# lower-better, so the band is applied as a ceiling: committed * 1.03).
+NOISE_BAND_PCT = 3.0
+EXIT_TPU_UNREACHABLE = 75
+
+
+def _repo_root() -> str:
+    return os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+
+
+def _bench_module():
+    """bench.py's probe/outage machinery, imported from the repo root
+    (it is a top-level driver, not a package module)."""
+    try:
+        import bench  # noqa: F401 — already importable (tests, repo cwd)
+        return bench
+    except ImportError:
+        root = _repo_root()
+        if root not in sys.path and os.path.exists(
+            os.path.join(root, "bench.py")
+        ):
+            sys.path.insert(0, root)
+            try:
+                import bench
+                return bench
+            except ImportError:
+                pass
+    return None
+
+
+def _tunebench_path(explicit: str | None) -> str:
+    return explicit or os.path.join(_repo_root(), "TUNEBENCH.json")
+
+
+def _last_known_good(path: str) -> dict | None:
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        return {
+            "value": float(data["value"]),
+            "source": os.path.basename(path),
+            "note": "committed last-known-good, NOT a fresh measurement",
+        }
+    except (OSError, KeyError, ValueError):
+        return None
+
+
+def _emit_unreachable(phase: str, error: str, bench_out: str) -> int:
+    """The one structured outage line (bench.py's schema, mode "tune")."""
+    print(
+        json.dumps(
+            {
+                "error": "tpu_unreachable",
+                "mode": "tune",
+                "phase": phase,
+                "metric": "nms_postprocess_ms_per_batch",
+                "attempts": 1,
+                "last_error": str(error)[-2000:],
+                "last_known_good": _last_known_good(bench_out),
+                "exit_code": EXIT_TPU_UNREACHABLE,
+            }
+        ),
+        flush=True,
+    )
+    return EXIT_TPU_UNREACHABLE
+
+
+def _parse_hw(text: str) -> tuple[int, int]:
+    try:
+        h, w = text.lower().split("x")
+        return int(h), int(w)
+    except ValueError:
+        raise SystemExit(f"--hw: not an HxW shape: {text!r}") from None
+
+
+def _check(args, search_lib) -> int:
+    """tunebench-check: re-measure the committed winner, enforce the band."""
+    path = _tunebench_path(args.bench_out)
+    try:
+        with open(path) as f:
+            committed = json.load(f)
+        committed_ms = float(committed["value"])
+    except (OSError, KeyError, ValueError) as e:
+        print(f"# tunebench-check: cannot read committed record: {e}")
+        return 1
+    import jax
+
+    device_kind = jax.devices()[0].device_kind
+    committed_device = committed.get("device_kind")
+    # bench.py's _check_floor device-class guard, ms-ceiling edition:
+    # cross-device latencies are not comparable, so mismatches pass loudly.
+    if committed_device != device_kind:
+        print(
+            f"# tunebench-check: committed record was captured on "
+            f"{committed_device or 'an unrecorded accelerator'!r} but this "
+            f"run is on {device_kind!r}; latencies are not comparable "
+            "across device classes — re-capture with `make tunebench`"
+        )
+        return 0
+    hw = tuple(committed.get("hw", list(search_lib.DEFAULT_HW)))
+    batch = int(committed.get("batch", search_lib.DEFAULT_BATCH))
+    winner = dict(committed.get("winner", {"impl": "xla"}))
+    trial = search_lib.run_trial(
+        "nms", winner, search_lib._nms_builder(batch, hw), args.steps
+    )
+    if trial.status != "ok":
+        print(f"# tunebench-check: re-measurement failed: {trial.error}")
+        return 1
+    # Noise-aware ceiling: the committed record's own two-window spread is
+    # its measured noise floor (bench.py's window policy), so the band is
+    # max(3%, that spread) — on the chip (~0.3% spread) this keeps bench-
+    # check's 3% teeth; on a noisy CPU fallback it stops scheduler jitter
+    # from reading as regression.  The fresh side compares its BEST window:
+    # a real regression slows every window, a descheduled one doesn't.
+    band_pct = max(NOISE_BAND_PCT, float(committed.get("noise_pct") or 0.0))
+    fresh = min(trial.window_ms) if trial.window_ms else trial.ms_per_call
+    ceiling = committed_ms * (1 + band_pct / 100)
+    verdict = "ok" if fresh <= ceiling else "REGRESSION"
+    print(
+        f"# tunebench-check: {fresh:.2f} ms/batch (best window of "
+        f"{trial.window_ms}) vs committed {committed_ms:.2f} (ceiling "
+        f"{ceiling:.2f} = +{band_pct:.2f}%): {verdict}"
+    )
+    return 0 if verdict == "ok" else 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m batchai_retinanet_horovod_coco_tpu.tune",
+        description="measured schedule search → per-device registry artifact",
+    )
+    ap.add_argument(
+        "--ops", default="nms,focal,matching",
+        help="comma list of op families to search (nms,focal,matching)",
+    )
+    ap.add_argument(
+        "--batch-axis", action="store_true",
+        help="also search per-bucket batch sizes (eval/serve tables)",
+    )
+    ap.add_argument("--hw", default=None, metavar="HxW",
+                    help="bucket to measure at (default: flagship 800x1344)")
+    ap.add_argument("--batch", type=int, default=None,
+                    help="batch size for op trials (default 8)")
+    ap.add_argument("--steps", type=int, default=None,
+                    help="timed calls per trial, split into two windows")
+    ap.add_argument(
+        "--include-semantic", action="store_true",
+        help="also measure non-default pre_nms_size values (recorded as "
+             "semantics-approx trials; never auto-promoted to winner)",
+    )
+    ap.add_argument(
+        "--smoke", action="store_true",
+        help="CPU-sized smoke: tiny bucket/steps, no probe — proves the "
+             "search end-to-end and commits an xla-winner artifact",
+    )
+    ap.add_argument("--device-kind", default=None,
+                    help="override the artifact's device_kind (tests)")
+    ap.add_argument("--out-root", default=None,
+                    help="registry dir (default artifacts/schedules/)")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print the artifact instead of writing it")
+    ap.add_argument("--bench-out", default=None, metavar="TUNEBENCH.json",
+                    help="also write the tripwire record here")
+    ap.add_argument("--check", action="store_true",
+                    help="tunebench-check mode: re-measure the committed "
+                         "TUNEBENCH winner and enforce the noise band")
+    ap.add_argument("--trace", "--obs-trace", action="store_true",
+                    dest="trace",
+                    help="record tune_search/tune_trial spans to a "
+                         "Perfetto-loadable trace in --obs-dir")
+    ap.add_argument("--obs-dir", default="artifacts/obs",
+                    help="where --trace writes its artifacts")
+    args = ap.parse_args(argv)
+
+    # Smoke defaults: small enough that a 2-vCPU box finishes in seconds.
+    hw = _parse_hw(args.hw) if args.hw else ((256, 256) if args.smoke else None)
+    batch = args.batch if args.batch is not None else (2 if args.smoke else None)
+    steps = args.steps if args.steps is not None else (4 if args.smoke else None)
+    args.steps = steps if steps is not None else 30
+
+    from batchai_retinanet_horovod_coco_tpu.obs import trace as obs_trace
+
+    if args.trace:
+        obs_trace.configure(args.obs_dir, process_label="tune")
+
+    bench = _bench_module()
+    bench_out = _tunebench_path(args.bench_out)
+    # bench.py's subprocess probe: a dead tunnel can HANG in-process
+    # backend init, which only a subprocess can bound.  It guards --check
+    # too (the check's own jax.devices() would be the unbounded hang);
+    # only --smoke skips it (CPU path, no tunnel to die).
+    if (
+        not args.smoke
+        and bench is not None
+        and os.environ.get("BENCH_PROBE", "1") not in ("", "0")
+    ):
+        attempts, err = bench.probe_device()
+        if err is not None:
+            return _emit_unreachable("probe", err, bench_out)
+
+    try:
+        from batchai_retinanet_horovod_coco_tpu.tune import search as search_lib
+
+        if args.check:
+            return _check(args, search_lib)
+
+        kwargs = {}
+        if hw is not None:
+            kwargs["hw"] = hw
+        if batch is not None:
+            kwargs["batch"] = batch
+        doc = search_lib.run_search(
+            ops=tuple(p for p in args.ops.split(",") if p),
+            steps=args.steps,
+            include_semantic=args.include_semantic,
+            search_batches=args.batch_axis,
+            device_kind=args.device_kind,
+            **kwargs,
+        )
+
+        from batchai_retinanet_horovod_coco_tpu.tune import (
+            schedule as schedule_lib,
+        )
+
+        summary = {
+            "device_kind": doc["device_kind"],
+            "entries": doc["entries"],
+            "trials": len(doc["trials"]),
+            "failed": sum(
+                1 for t in doc["trials"] if t["status"] == "failed"
+            ),
+            "skipped": sum(
+                1 for t in doc["trials"] if t["status"] == "skipped"
+            ),
+        }
+        if args.dry_run:
+            print(json.dumps(doc, indent=2, sort_keys=True))
+            return 0
+        path = schedule_lib.save_schedule(doc, args.out_root)
+        summary["artifact"] = path
+        print(json.dumps(summary, sort_keys=True), flush=True)
+
+        if args.bench_out is not None:
+            nms_trials = [
+                t for t in doc["trials"]
+                if t["op"] == "nms" and t["status"] == "ok"
+                and t["params"] == doc["entries"].get("nms")
+            ]
+            if not nms_trials:
+                print("# tunebench: no NMS winner trial to commit")
+                return 1
+            win = nms_trials[0]
+            record = {
+                "metric": "nms_postprocess_ms_per_batch",
+                "mode": "tune",
+                "value": win["ms_per_call"],
+                "unit": "ms/batch (lower is better)",
+                "device_kind": doc["device_kind"],
+                "hw": list(hw or search_lib.DEFAULT_HW),
+                "batch": batch or search_lib.DEFAULT_BATCH,
+                "steps": args.steps,
+                "noise_pct": win["noise_pct"],
+                "winner": doc["entries"]["nms"],
+                "schedule_artifact": os.path.relpath(path, _repo_root()),
+            }
+            with open(bench_out, "w") as f:
+                json.dump(record, f, indent=2, sort_keys=True)
+                f.write("\n")
+            print(f"# tunebench record written to {bench_out}")
+        return 0
+    except SystemExit:
+        raise
+    except Exception as e:
+        # The probe can pass and the device die mid-search — still an
+        # outage, not a tuner bug (bench.py's mid-run contract).
+        from batchai_retinanet_horovod_coco_tpu.tune import search as search_lib
+
+        if isinstance(e, search_lib.DeviceUnavailable) or (
+            bench is not None and bench.is_unavailable_error(e)
+        ):
+            return _emit_unreachable("mid-run", str(e), bench_out)
+        raise
+    finally:
+        if args.trace:
+            obs_trace.export()
+            merged = obs_trace.merge_traces(out_name="tune_trace.json")
+            print(f"# trace written to {merged}", flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

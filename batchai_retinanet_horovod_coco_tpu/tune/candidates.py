@@ -1,0 +1,90 @@
+"""Candidate generation for the schedule search (tune/search.py).
+
+Each op family yields a small explicit list of candidate parameter dicts
+— the spaces are tiny (tile shapes bounded by VMEM, impls by what exists)
+so the search is exhaustive-by-default rather than sampled; TVM-style
+learned cost models are unwarranted at this scale.  Every candidate dict
+is directly mergeable into the schedule registry's ``entries[op]``
+(tune/schedule.py), so the winner IS the artifact entry.
+
+Semantics notes per axis:
+
+- ``impl`` and tile/block sizes are performance-only: every impl pair is
+  bit-identical (the parity suites pin it), so the search may pick freely.
+- ``pre_nms_size`` CHANGES DETECTION SEMANTICS (fewer candidates survive
+  to NMS → mAP can move).  It is still a legitimate axis — the reference
+  hand-picked 1000 with no measurement — but non-default values are only
+  emitted when the caller opts in (``include_semantic=True``), and the
+  search records them as ``semantics: "approx"`` trials so a human
+  committing a winner sees the tradeoff (RUNBOOK "Autotuning schedules").
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable
+
+# VMEM-bounded tile menus.  Focal backward holds more live temps than
+# forward (grad + recomputed p/log terms), hence the smaller ceiling —
+# see ops/pallas/focal.py's FWD/BWD_TILE_A notes.
+NMS_BLOCKS = (128, 256, 512)
+FOCAL_FWD_TILES = (4096, 8192, 16384)
+FOCAL_BWD_TILES = (2048, 4096)
+MATCHING_TILES = (4096, 8192, 16384)
+PRE_NMS_SIZES = (512, 1000, 2048)
+BATCH_SIZES = (2, 4, 8, 16)
+
+
+def nms_candidates(
+    include_semantic: bool = False,
+    blocks: Iterable[int] = NMS_BLOCKS,
+    pre_nms_sizes: Iterable[int] = PRE_NMS_SIZES,
+) -> list[dict[str, Any]]:
+    """XLA baseline + one kernel candidate per block size (× pre_nms when
+    the caller opts into the semantics-affecting axis)."""
+    pres = tuple(pre_nms_sizes) if include_semantic else (1000,)
+    out: list[dict[str, Any]] = []
+    for pre in pres:
+        out.append({"impl": "xla", "pre_nms_size": pre})
+        for blk in blocks:
+            out.append({"impl": "pallas", "block_k": blk, "pre_nms_size": pre})
+    return out
+
+
+def focal_candidates(
+    fwd_tiles: Iterable[int] = FOCAL_FWD_TILES,
+    bwd_tiles: Iterable[int] = FOCAL_BWD_TILES,
+) -> list[dict[str, Any]]:
+    out: list[dict[str, Any]] = [{"impl": "xla"}]
+    for fwd in fwd_tiles:
+        for bwd in bwd_tiles:
+            out.append({"impl": "pallas", "fwd_tile_a": fwd, "bwd_tile_a": bwd})
+    return out
+
+
+def matching_candidates(
+    tiles: Iterable[int] = MATCHING_TILES,
+) -> list[dict[str, Any]]:
+    out: list[dict[str, Any]] = [{"impl": "xla"}]
+    for tile in tiles:
+        out.append({"impl": "pallas", "tile_a": tile})
+    return out
+
+
+def batch_candidates(sizes: Iterable[int] = BATCH_SIZES) -> list[dict[str, Any]]:
+    """Per-bucket batch-size axis (eval/detect throughput per chip)."""
+    return [{"batch": b} for b in sizes]
+
+
+def candidates_for(op: str, **kwargs: Any) -> list[dict[str, Any]]:
+    try:
+        fn = {
+            "nms": nms_candidates,
+            "focal": focal_candidates,
+            "matching": matching_candidates,
+            "batch": batch_candidates,
+        }[op]
+    except KeyError:
+        raise ValueError(
+            f"unknown op {op!r}; known: batch, focal, matching, nms"
+        ) from None
+    return fn(**kwargs)

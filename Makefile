@@ -22,7 +22,7 @@ CLUSTER = python -m batchai_retinanet_horovod_coco_tpu.launch.cluster
 	bench-check bench-pipeline pipebench pipebench-check evalbench \
 	evalbench-check servebench servebench-check canaries \
 	convergence-full lint lint-obs check-static tune-smoke tunebench \
-	tunebench-check
+	tunebench-check perf-report perf-report-check
 
 create:
 	$(CLUSTER) create --name $(NAME) --zone $(ZONE) --accelerator $(ACCEL) $(DRYFLAG)
@@ -68,6 +68,7 @@ bench-check:
 	BENCH_SWEEP=0 BENCH_CHECK=1 python bench.py
 	BENCH_SWEEP=0 EVALBENCH_E2E=0 BENCH_CHECK=1 python bench.py --mode eval
 	BENCH_SWEEP=0 SERVEBENCH_OVERLOAD=0 BENCH_CHECK=1 python bench.py --mode serve
+	$(MAKE) perf-report-check
 
 # Eval/detect fast-path bench (ISSUE 2): per-bucket AOT detect + NMS-only
 # ms/batch + sequential-vs-pipelined end-to-end comparison, one JSON line.
@@ -152,6 +153,35 @@ tunebench:
 # captured on another device class passes with a loud re-capture note).
 tunebench-check:
 	python -m batchai_retinanet_horovod_coco_tpu.tune --check
+
+# Perf doctor (ISSUE 8, obs/analyze): turn an obs dir's own artifacts
+# (merged trace.json + metrics.jsonl) into one machine-readable
+# PERF_REPORT.json — step-time decomposition, pipeline overlap
+# efficiency, queue/stall correlation, MFU estimate, ranked top-3
+# bottleneck verdict (RUNBOOK "Perf doctor").  perf-report analyzes an
+# existing obs dir (OBS_DIR, default artifacts/obs — any --obs-trace run
+# auto-emits the same report at exit; this target is the post-hoc path).
+OBS_DIR ?= artifacts/obs
+perf-report:
+	python -m batchai_retinanet_horovod_coco_tpu.obs.analyze $(OBS_DIR)
+
+# perf-report-check: regression tripwire — run the standard traced CPU
+# smoke (train+eval, ~2 min; --platform cpu so the attribution baseline
+# is device-stable), analyze it, schema-validate the report, and enforce
+# the attribution-fraction band (PERF_BAND_ABS, default ±0.20 absolute)
+# against the committed repo-root PERF_REPORT.json — same device-class
+# guard as bench-check (a baseline captured on another device class
+# passes with a loud re-capture note).
+PERF_OBS_DIR ?= /tmp/perf_report_check_obs
+perf-report-check:
+	rm -rf $(PERF_OBS_DIR)
+	python train.py synthetic --platform cpu --backbone resnet_test --f32 \
+	  --image-min-side 64 --image-max-side 64 --batch-size 4 \
+	  --num-devices 1 --steps 20 --eval-every 10 --synthetic-size 64 \
+	  --synthetic-root /tmp/perf_report_check_data \
+	  --obs-trace --obs-dir $(PERF_OBS_DIR)
+	python -m batchai_retinanet_horovod_coco_tpu.obs.analyze \
+	  $(PERF_OBS_DIR) --check
 
 # Host input-pipeline bench: threads-vs-procs sweep (bench_pipeline.py).
 # pipebench-check is the regression tripwire twin of bench-check: measured

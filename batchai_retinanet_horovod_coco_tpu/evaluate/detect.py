@@ -629,7 +629,11 @@ def collect_detections(
                 prev_det, prev_meta = pending
                 fetched = fetch(prev_det)
                 hb.idle()  # a full consumer queue is backpressure
-                consumer.put(fetched, *prev_meta)
+                # Named span so the perf doctor can tell consumer
+                # backpressure (slow host conversion/scoring) apart from
+                # fetch blocking (slow device NMS) in the same driver.
+                with trace.span("eval_put_wait"):
+                    consumer.put(fetched, *prev_meta)
                 hb.beat()
             pending = (det, (image_ids, scales, valid))
         if pending is not None:
@@ -637,7 +641,8 @@ def collect_detections(
             pending = None
             fetched = fetch(prev_det)
             hb.idle()
-            consumer.put(fetched, *prev_meta)
+            with trace.span("eval_put_wait"):
+                consumer.put(fetched, *prev_meta)
         hb.idle()  # finish() legitimately blocks on the consumer's drain
         return consumer.finish()
     finally:

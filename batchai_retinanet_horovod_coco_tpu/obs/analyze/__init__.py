@@ -1,0 +1,51 @@
+"""Perf doctor (ISSUE 8): the obs subsystem's read side.
+
+``report`` turns a run's own artifacts (merged ``trace.json``, events
+JSONL via ``split_runs``, watchdog markers) into one machine-readable
+``PERF_REPORT.json`` — step-time decomposition, pipeline overlap
+efficiency, queue/stall correlation, memory trend, an MFU estimate from
+the recorded XLA cost-analysis FLOPs, and a ranked top-3 bottleneck
+verdict naming the spans and ``tune/`` problems to attack next.
+
+Three entrypoints:
+
+- inline auto-emit at ``train.py``/``bench.py`` finalize (``auto_emit``
+  — never raises; failure is one structured event);
+- offline CLI: ``python -m batchai_retinanet_horovod_coco_tpu.obs.analyze
+  <obs_dir>`` (byte-identical to the inline report for the same dir);
+- ``make perf-report`` / ``make perf-report-check`` (schema validation +
+  regression band on the attribution fractions vs the committed
+  PERF_REPORT.json, bench-check's device-class guard).
+
+jax-free: the analyzer reads artifacts, never devices.
+"""
+
+from batchai_retinanet_horovod_coco_tpu.obs.analyze.report import (
+    AnalyzeError,
+    CPU_NOMINAL_PEAK_TFLOPS,
+    PEAK_TFLOPS,
+    SCHEMA_VERSION,
+    analyze_dir,
+    analyze_events,
+    auto_emit,
+    device_peak_tflops,
+    load_trace,
+    span_attribution,
+    validate_report,
+    write_report,
+)
+
+__all__ = [
+    "AnalyzeError",
+    "CPU_NOMINAL_PEAK_TFLOPS",
+    "PEAK_TFLOPS",
+    "SCHEMA_VERSION",
+    "analyze_dir",
+    "analyze_events",
+    "auto_emit",
+    "device_peak_tflops",
+    "load_trace",
+    "span_attribution",
+    "validate_report",
+    "write_report",
+]

@@ -1,0 +1,949 @@
+"""The perf doctor: obs artifacts → one machine-readable PERF_REPORT.json.
+
+The read-side half of the observability subsystem (ISSUE 8).  PR 3 made
+every run write where-the-time-went evidence — merged Chrome trace,
+structured events JSONL, watchdog dumps — but only a human in Perfetto
+could interpret it, so nothing ever *named* the hot path the next perf PR
+should attack.  This module is that interpreter: a deterministic pure
+function from a run's own artifacts to
+
+- **step-time decomposition** — data_wait / compile / step / eval
+  fractions of the train-loop window, from the existing span vocabulary;
+- **pipeline overlap efficiency** — how well the one-behind eval and
+  serve drivers hide device time behind host work, measured as
+  ``1 - blocked_fetch_time / pipeline_wall`` over the dispatch/fetch
+  span pairs (1.0 = the host never waited on the device);
+- **queue-depth stall correlation** — the Chrome counter tracks
+  cross-referenced against ``data_wait`` spans: how much of the host's
+  blocked time the device-prefetch queue was empty (starved) vs merely
+  slow;
+- **memory trend** — first/last/peak and bytes-per-second slope of every
+  device ``bytes_in_use`` gauge (HBM headroom is peak vs the device's
+  capacity; CPU backends report nothing and the section says so);
+- **an MFU estimate** — the XLA-counted step FLOPs the train loop records
+  at each compile point (``cost_analysis`` trace instants, from the
+  unoptimized lowering — no second backend compile) against the device's
+  peak TFLOP/s, so the roofline number exists per RUN, not only per
+  bench;
+- **a ranked top-3 bottleneck verdict** — each entry names the spans to
+  stare at in Perfetto and the ``tune/`` problems (``nms``, ``focal``,
+  ``matching``, ``batch``) the next optimization PR should search.
+
+Determinism contract: the report is a pure function of the artifact
+files — no wall clocks, no environment probes (the peak-TFLOPs env
+override excepted), floats rounded through one helper — so the inline
+auto-emit at ``train.py``/``bench.py`` finalize and the offline CLI
+(``python -m batchai_retinanet_horovod_coco_tpu.obs.analyze <obs_dir>``)
+produce byte-identical files from the same obs dir (pinned against the
+committed fixture in tests/unit/test_analyze.py).
+
+jax-free by design: the analyzer runs on artifacts, not on devices, so
+the offline CLI starts in milliseconds and the module obeys the same
+import discipline as the rest of obs/.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Any, Iterable
+
+from batchai_retinanet_horovod_coco_tpu.obs.events import (
+    latency_percentiles,
+    split_runs,
+)
+
+SCHEMA_VERSION = 1
+
+# Peak dense bf16 TFLOP/s per chip by device kind (public spec sheets) —
+# THE table, shared with bench.py's MFU line (one source of truth).
+PEAK_TFLOPS = (
+    ("v5 lite", 197.0),  # v5e
+    ("v5e", 197.0),
+    ("v5p", 459.0),
+    ("v4", 275.0),
+    ("v6", 918.0),  # Trillium
+)
+
+# Nominal per-host figure for CPU smokes: MFU against it is order-of-
+# magnitude only (the report labels it ``peak_source: "nominal-cpu"``),
+# but it keeps the roofline field populated end-to-end on dev boxes.
+CPU_NOMINAL_PEAK_TFLOPS = 0.05
+
+# The train loop's top-level span vocabulary (train/loop.py): these names
+# partition the loop thread's wall clock, so their fractions + "other"
+# sum to ~1 by construction.
+_TRAIN_VOCAB = (
+    "data_wait",
+    "compile_train_step",
+    "step",
+    "metrics_fetch",
+    "eval",
+    "final_eval",
+)
+
+# Decomposition keys the report always carries (fixed set → stable schema).
+_DECOMP_KEYS = ("data_wait", "compile", "step", "metrics_fetch", "eval", "other")
+
+# Span families worth per-family latency stats when present (fixed list →
+# deterministic report keys).
+_SPAN_STAT_NAMES = (
+    "data_wait",
+    "step",
+    "compile_train_step",
+    "metrics_fetch",
+    "eval",
+    "final_eval",
+    "async_eval",
+    "detect_dispatch",
+    "detect_fetch",
+    "eval_convert",
+    "eval_score",
+    "eval_put_wait",
+    "serve_dispatch",
+    "serve_fetch",
+    "serve_convert",
+    "serve_preprocess",
+    "pipe_decode_wait",
+    "shm_head_wait",
+    "shm_assemble",
+    "decode",
+    "device-prefetch",
+    "eval-device-prefetch",
+)
+
+# The host-feed queue whose depth the stall correlation reads (the
+# device-prefetch thread's counter, data/prefetch.py): data_wait with this
+# at 0 is a STARVED pipeline (add workers); data_wait with depth > 0 is a
+# transfer/dispatch hiccup.
+_FEED_QUEUE = "device-prefetch.qsize"
+
+
+class AnalyzeError(RuntimeError):
+    """Artifact missing/unreadable in a way the caller should surface."""
+
+
+def _r(x: float | None, nd: int = 6) -> float | None:
+    return None if x is None else round(float(x), nd)
+
+
+def device_peak_tflops(device_kind: str | None) -> tuple[float | None, str | None]:
+    """(peak TFLOP/s, provenance) for a device kind.  Provenance is
+    ``spec`` (public sheet), ``nominal-cpu`` (order-of-magnitude host
+    figure), ``env`` (RETINANET_PEAK_TFLOPS override for kinds the table
+    doesn't know), or None/None when unresolvable."""
+    if not device_kind:
+        return None, None
+    kind = device_kind.lower()
+    for sub, peak in PEAK_TFLOPS:
+        if sub in kind:
+            return peak, "spec"
+    env = os.environ.get("RETINANET_PEAK_TFLOPS")
+    if env:
+        try:
+            return float(env), "env"
+        except ValueError:
+            pass
+    if "cpu" in kind:
+        return CPU_NOMINAL_PEAK_TFLOPS, "nominal-cpu"
+    return None, None
+
+
+# ---------------------------------------------------------------------------
+# Artifact loading
+# ---------------------------------------------------------------------------
+
+
+def load_trace(path: str) -> tuple[list[dict], dict]:
+    """trace.json → (chrome events, health counters).  Raises AnalyzeError
+    on a missing/unreadable file; a structurally odd but parseable file
+    degrades to whatever events it carries."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except OSError as e:
+        raise AnalyzeError(f"cannot read trace {path!r}: {e}") from e
+    except ValueError as e:
+        raise AnalyzeError(f"trace {path!r} is not valid JSON: {e}") from e
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        raise AnalyzeError(f"trace {path!r} has no traceEvents list")
+    other = doc.get("otherData") or {}
+    health = {
+        "merged_partials": len(other.get("merged_from") or []),
+        "skipped_trace_partials": len(other.get("skipped") or []),
+    }
+    return [e for e in events if isinstance(e, dict)], health
+
+
+def _spans_by_name(events: Iterable[dict]) -> dict[str, list[dict]]:
+    out: dict[str, list[dict]] = {}
+    for e in events:
+        if e.get("ph") == "X":
+            out.setdefault(e.get("name", "?"), []).append(e)
+    return out
+
+
+def _counters_by_name(events: Iterable[dict]) -> dict[str, list[tuple[float, float]]]:
+    """counter name → [(t_s, value)] sorted by time."""
+    out: dict[str, list[tuple[float, float]]] = {}
+    for e in events:
+        if e.get("ph") == "C":
+            try:
+                v = float((e.get("args") or {})["value"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            out.setdefault(e.get("name", "?"), []).append((e["ts"] / 1e6, v))
+    for series in out.values():
+        series.sort()
+    return out
+
+
+def _instants(events: Iterable[dict], name: str) -> list[dict]:
+    return [
+        e for e in events if e.get("ph") == "i" and e.get("name") == name
+    ]
+
+
+def _dur_s(e: dict) -> float:
+    return e.get("dur", 0) / 1e6
+
+
+def _start_s(e: dict) -> float:
+    return e["ts"] / 1e6
+
+
+def _end_s(e: dict) -> float:
+    return (e["ts"] + e.get("dur", 0)) / 1e6
+
+
+# ---------------------------------------------------------------------------
+# Sections
+# ---------------------------------------------------------------------------
+
+
+def _steps_section(spans: dict[str, list[dict]]) -> dict | None:
+    """Step-time decomposition over the train-loop thread's window.
+
+    The window is the extent of the loop's top-level spans on the track
+    that carries the ``step`` spans; those spans never nest among
+    themselves (train/loop.py), so their totals plus an explicit
+    ``other`` remainder partition the window and the fractions sum to ~1.
+    """
+    steps = spans.get("step") or []
+    if not steps:
+        return None
+    # The loop thread's track: where the step spans live (a merged trace
+    # carries every process; per-(pid,tid) keying keeps e.g. an async-eval
+    # thread's spans out of the loop's accounting).
+    track_counts: dict[tuple, int] = {}
+    for e in steps:
+        track_counts[(e.get("pid"), e.get("tid"))] = (
+            track_counts.get((e.get("pid"), e.get("tid")), 0) + 1
+        )
+    # Deterministic tie-break via str() — pid/tid may be absent in
+    # hand-built traces and None does not order against ints.
+    track = max(track_counts, key=lambda k: (track_counts[k], str(k)))
+
+    def on_track(name: str) -> list[dict]:
+        return [
+            e
+            for e in spans.get(name, [])
+            if (e.get("pid"), e.get("tid")) == track
+        ]
+
+    vocab = {name: on_track(name) for name in _TRAIN_VOCAB}
+    all_spans = [e for group in vocab.values() for e in group]
+    window_start = min(_start_s(e) for e in all_spans)
+    window_end = max(_end_s(e) for e in all_spans)
+    window_s = max(window_end - window_start, 1e-9)
+
+    totals = {name: sum(_dur_s(e) for e in group) for name, group in vocab.items()}
+    eval_s = totals["eval"] + totals["final_eval"]
+    attributed = {
+        "data_wait": totals["data_wait"],
+        "compile": totals["compile_train_step"],
+        "step": totals["step"],
+        "metrics_fetch": totals["metrics_fetch"],
+        "eval": eval_s,
+    }
+    other = max(0.0, window_s - sum(attributed.values()))
+    decomposition = {k: _r(v / window_s) for k, v in attributed.items()}
+    decomposition["other"] = _r(other / window_s)
+
+    step_track = vocab["step"]
+    first_step = min(_start_s(e) for e in step_track)
+    last_step = max(_end_s(e) for e in step_track)
+    # Steady-state step cadence: everything between first and last step
+    # minus the one-off gaps (compiles, in-loop evals) that are attributed
+    # to their own verdicts.  MFU reads this, not the raw window.
+    active_s = max(
+        (last_step - first_step)
+        - sum(
+            _dur_s(e)
+            for name in ("compile_train_step", "eval")
+            for e in vocab[name]
+            if _start_s(e) >= first_step and _end_s(e) <= last_step
+        ),
+        1e-9,
+    )
+    return {
+        "count": len(step_track),
+        "window_s": _r(window_s),
+        "active_train_s": _r(active_s),
+        "steps_per_s": _r(len(step_track) / active_s),
+        "decomposition": decomposition,
+        "fractions_sum": _r(sum(decomposition.values())),
+        "totals_s": {k: _r(v, 4) for k, v in attributed.items()},
+    }
+
+
+def _span_stats(spans: dict[str, list[dict]]) -> dict:
+    out = {}
+    for name in _SPAN_STAT_NAMES:
+        group = spans.get(name)
+        if not group:
+            continue
+        stats = latency_percentiles([_dur_s(e) * 1e3 for e in group])
+        stats["total_s"] = _r(sum(_dur_s(e) for e in group), 4)
+        out[name] = stats
+    return out
+
+
+def _overlap_section(
+    spans: dict[str, list[dict]],
+    dispatch_name: str,
+    fetch_name: str,
+    convert_name: str | None,
+) -> dict | None:
+    """One-behind pipeline efficiency from a dispatch/fetch span pair.
+
+    With perfect overlap the host's ``fetch`` (device_get) barely blocks:
+    the device finished batch N−1 while the host dispatched/converted
+    batch N.  With no overlap the host spends its whole non-dispatch time
+    blocked in fetch.  ``overlap_efficiency = 1 − Σfetch / wall`` maps
+    those extremes to ~1 and ~0 on the pipeline's own wall clock.
+    """
+    dispatch = spans.get(dispatch_name) or []
+    fetch = spans.get(fetch_name) or []
+    if not dispatch or not fetch:
+        return None
+    wall_start = min(_start_s(e) for e in dispatch + fetch)
+    wall_end = max(_end_s(e) for e in dispatch + fetch)
+    wall_s = max(wall_end - wall_start, 1e-9)
+    dispatch_s = sum(_dur_s(e) for e in dispatch)
+    fetch_s = sum(_dur_s(e) for e in fetch)
+    out = {
+        "batches": len(dispatch),
+        "wall_s": _r(wall_s),
+        "dispatch_s": _r(dispatch_s, 4),
+        "fetch_blocked_s": _r(fetch_s, 4),
+        "overlap_efficiency": _r(min(1.0, max(0.0, 1.0 - fetch_s / wall_s))),
+    }
+    if convert_name:
+        convert = spans.get(convert_name) or []
+        if convert:
+            convert_s = sum(_dur_s(e) for e in convert)
+            # Host conversion that ran while the driver stream was still
+            # in flight (the consumer-thread overlap the pipelined eval
+            # exists for).
+            overlapped = sum(
+                max(
+                    0.0,
+                    min(_end_s(e), wall_end) - max(_start_s(e), wall_start),
+                )
+                for e in convert
+            )
+            out["convert_s"] = _r(convert_s, 4)
+            out["convert_overlap"] = _r(
+                min(1.0, overlapped / max(convert_s, 1e-9))
+            )
+    return out
+
+
+def _queue_section(
+    counters: dict[str, list[tuple[float, float]]],
+    data_wait_spans: list[dict],
+) -> dict:
+    out: dict[str, dict] = {}
+    for name, series in sorted(counters.items()):
+        if _is_memory_gauge(name):
+            continue
+        values = [v for _, v in series]
+        out[name] = {
+            "samples": len(values),
+            "mean": _r(sum(values) / len(values), 3),
+            "min": _r(min(values), 3),
+            "max": _r(max(values), 3),
+            "zero_fraction": _r(
+                sum(1 for v in values if v == 0) / len(values)
+            ),
+        }
+    feed = counters.get(_FEED_QUEUE)
+    if feed and data_wait_spans:
+        # Cross-reference: at each data_wait span's start, what depth did
+        # the feed queue last report?  Time-weighted by span duration so
+        # one long starvation outweighs many micro-waits.
+        starved = 0.0
+        total = 0.0
+        times = [t for t, _ in feed]
+        for e in data_wait_spans:
+            t0 = _start_s(e)
+            depth = None
+            lo, hi = 0, len(times)
+            while lo < hi:  # rightmost sample at/before t0
+                mid = (lo + hi) // 2
+                if times[mid] <= t0:
+                    lo = mid + 1
+                else:
+                    hi = mid
+            if lo > 0:
+                depth = feed[lo - 1][1]
+            total += _dur_s(e)
+            if depth is not None and depth == 0:
+                starved += _dur_s(e)
+        if total > 0:
+            out.setdefault(_FEED_QUEUE, {})["starved_data_wait_fraction"] = _r(
+                starved / total
+            )
+    return out
+
+
+def _is_memory_gauge(name: str) -> bool:
+    return name.startswith("dev") and name.endswith(
+        ("bytes_in_use", "peak_bytes_in_use")
+    )
+
+
+def _memory_section(counters: dict[str, list[tuple[float, float]]]) -> dict:
+    gauges = {n: s for n, s in counters.items() if _is_memory_gauge(n)}
+    if not gauges:
+        return {"available": False}
+    out: dict[str, Any] = {"available": True, "gauges": {}}
+    for name, series in sorted(gauges.items()):
+        (t0, v0), (t1, v1) = series[0], series[-1]
+        g = {
+            "samples": len(series),
+            "first_bytes": _r(v0, 0),
+            "last_bytes": _r(v1, 0),
+            "peak_bytes": _r(max(v for _, v in series), 0),
+        }
+        if t1 > t0:
+            g["trend_bytes_per_s"] = _r((v1 - v0) / (t1 - t0), 1)
+        out["gauges"][name] = g
+    return out
+
+
+def _events_section(events_path: str | None) -> dict:
+    if not events_path or not os.path.exists(events_path):
+        return {"available": False}
+    try:
+        runs = split_runs(events_path)
+    except OSError as e:
+        return {"available": False, "error": repr(e)[:200]}
+    if not runs:
+        return {"available": False}
+    run = runs[-1]  # the most recent run in an append-mode file
+    header = run.get("header") or {}
+    records = run.get("records") or []
+    compiles = [r for r in records if r.get("event") == "compile"]
+    stalls = [r for r in records if r.get("event") == "watchdog_stall"]
+    dropped = sum(len(r.get("dropped_metrics") or []) for r in records)
+    return {
+        "available": True,
+        "runs_in_file": len(runs),
+        "corrupt_lines": sum(len(r.get("corrupt") or []) for r in runs),
+        "header": {
+            k: header.get(k)
+            for k in (
+                "run_id",
+                "device_kind",
+                "local_device_count",
+                "process_count",
+                "config_digest",
+            )
+        },
+        "compile": {
+            "count": len(compiles),
+            "build_s_total": _r(
+                sum(float(r.get("build_s") or 0.0) for r in compiles), 3
+            ),
+        },
+        "watchdog_stalls": len(stalls),
+        "dropped_metrics": dropped,
+    }
+
+
+def _mfu_section(
+    events: list[dict], steps: dict | None, device_kind: str | None
+) -> dict:
+    cost = [
+        e
+        for e in _instants(events, "cost_analysis")
+        if (e.get("args") or {}).get("target") == "train_step"
+    ]
+    flops_vals = [
+        float((e.get("args") or {}).get("flops") or 0.0) for e in cost
+    ]
+    flops_vals = [v for v in flops_vals if v > 0]
+    batches = [
+        int((e.get("args") or {}).get("batch") or 0) for e in cost
+    ]
+    batches = [b for b in batches if b > 0]
+    peak, peak_source = device_peak_tflops(device_kind)
+    out: dict[str, Any] = {
+        "flops_per_step": _r(
+            sum(flops_vals) / len(flops_vals), 1
+        )
+        if flops_vals
+        else None,
+        "flops_source": "trace_cost_analysis" if flops_vals else None,
+        "steps_per_s": steps.get("steps_per_s") if steps else None,
+        "images_per_s": None,
+        "achieved_tflops": None,
+        "peak_tflops": peak,
+        "peak_source": peak_source,
+        "mfu": None,
+    }
+    if flops_vals and steps and steps.get("steps_per_s"):
+        achieved = (
+            (sum(flops_vals) / len(flops_vals)) * steps["steps_per_s"] / 1e12
+        )
+        out["achieved_tflops"] = _r(achieved)
+        if batches:
+            out["images_per_s"] = _r(
+                steps["steps_per_s"] * sum(batches) / len(batches), 3
+            )
+        if peak:
+            out["mfu"] = _r(achieved / peak)
+    if peak_source == "nominal-cpu":
+        out["note"] = (
+            "peak is a nominal CPU figure; mfu is order-of-magnitude only"
+        )
+    return out
+
+
+def _stalls_section(events: list[dict], events_section: dict) -> dict:
+    markers = _instants(events, "stall")
+    components: dict[str, int] = {}
+    for e in markers:
+        c = str((e.get("args") or {}).get("component") or "?")
+        components[c] = components.get(c, 0) + 1
+    return {
+        "trace_markers": len(markers),
+        "jsonl_diagnoses": events_section.get("watchdog_stalls", 0)
+        if events_section.get("available")
+        else 0,
+        "components": {k: components[k] for k in sorted(components)},
+    }
+
+
+# Bottleneck → the tune/ problems that attack it (tune CLI --from-report
+# consumes these names directly: python -m ...tune --from-report).
+_TUNE_OPS = {
+    "device_step": ["focal", "matching", "nms"],
+    "eval_pipeline": ["nms", "batch"],
+    "eval_fetch_blocking": ["nms", "batch"],
+    "serve_fetch_blocking": ["nms", "batch"],
+    "host_input_pipeline": ["batch"],
+}
+
+
+def _bottlenecks(
+    steps: dict | None,
+    pipeline: dict,
+    spans: dict[str, list[dict]],
+    queues: dict,
+) -> list[dict]:
+    """Ranked verdicts, scores all expressed as fractions of the main
+    window so they are mutually comparable.  Non-empty whenever the trace
+    carries any span at all (the generic fallback ranks raw span
+    families when the train vocabulary is absent — bench traces)."""
+    cands: list[dict] = []
+    if steps is not None:
+        d = steps["decomposition"]
+        window_s = steps["window_s"]
+        starved = (queues.get(_FEED_QUEUE) or {}).get(
+            "starved_data_wait_fraction"
+        )
+        cands.append(
+            {
+                "name": "host_input_pipeline",
+                "score": d["data_wait"],
+                "spans": [
+                    "data_wait",
+                    "device-prefetch",
+                    "pipe_decode_wait",
+                    "shm_head_wait",
+                    "decode",
+                ],
+                "evidence": (
+                    f"host blocked on input {d['data_wait']:.1%} of the "
+                    f"window"
+                    + (
+                        f"; feed queue empty for {starved:.1%} of that"
+                        if starved is not None
+                        else ""
+                    )
+                ),
+                "suggestion": (
+                    "raise --data-worker-procs/--workers (RUNBOOK 'Feeding "
+                    "the chips'); starved feed queue = decode-bound host"
+                ),
+            }
+        )
+        cands.append(
+            {
+                "name": "compilation",
+                "score": d["compile"],
+                "spans": ["compile_train_step", "build_detect_fn"],
+                "evidence": f"compiles took {d['compile']:.1%} of the window",
+                "suggestion": (
+                    "one-time cost on long runs; persistent compile cache / "
+                    "AOT warmup if it dominates short ones"
+                ),
+            }
+        )
+        cands.append(
+            {
+                "name": "device_step",
+                "score": d["step"],
+                "spans": ["step"],
+                "evidence": f"device step {d['step']:.1%} of the window",
+                "suggestion": (
+                    "the roofline lever: fused Pallas kernels for focal/"
+                    "matching/NMS + a tune/ search on this device_kind"
+                ),
+            }
+        )
+        cands.append(
+            {
+                "name": "eval_pipeline",
+                "score": d["eval"],
+                "spans": ["eval", "final_eval", "detect_dispatch"],
+                "evidence": f"in-loop eval {d['eval']:.1%} of the window",
+                "suggestion": (
+                    "--async-eval overlaps eval with the step stream; "
+                    "tune/ batch axis raises detect throughput"
+                ),
+            }
+        )
+        cands.append(
+            {
+                "name": "logging_fetch",
+                "score": d["metrics_fetch"],
+                "spans": ["metrics_fetch"],
+                "evidence": (
+                    f"metric device_get {d['metrics_fetch']:.1%} of the "
+                    "window"
+                ),
+                "suggestion": "raise --log-every",
+            }
+        )
+    # Pipeline fetch-blocking verdicts exist with or WITHOUT a train loop
+    # (a bench eval/serve trace has no `step` spans, but its fetch
+    # blocking IS the detect-ceiling evidence tune/ exists to attack):
+    # normalized by the loop window when one exists, else by the
+    # pipeline's own wall.
+    for key, name, span_list, suggestion in (
+        (
+            "eval",
+            "eval_fetch_blocking",
+            ["detect_fetch", "eval_put_wait"],
+            "one-behind overlap is losing to device NMS time: tune/ nms "
+            "+ per-bucket batch",
+        ),
+        (
+            "serve",
+            "serve_fetch_blocking",
+            ["serve_fetch"],
+            "tune/ nms + serve batch sizes",
+        ),
+    ):
+        sec = pipeline.get(key)
+        if sec is None:
+            continue
+        denom = (
+            steps["window_s"] if steps is not None else sec["wall_s"]
+        )
+        if not denom:
+            continue
+        cands.append(
+            {
+                "name": name,
+                "score": _r(min(1.0, sec["fetch_blocked_s"] / denom)),
+                "spans": span_list,
+                "evidence": (
+                    f"{key} fetch blocked {sec['fetch_blocked_s']:.3f}s "
+                    f"(overlap_efficiency "
+                    f"{sec['overlap_efficiency']:.3f})"
+                ),
+                "suggestion": suggestion,
+            }
+        )
+    if steps is None:
+        # No train loop in this trace (bench/serve/tune artifacts): also
+        # rank raw span families by their share of the span-covered
+        # wall, skipping families a pipeline verdict already claims.
+        claimed = {s for c in cands for s in c["spans"]}
+        all_spans = [e for group in spans.values() for e in group]
+        if all_spans:
+            wall = max(_end_s(e) for e in all_spans) - min(
+                _start_s(e) for e in all_spans
+            )
+            wall = max(wall, 1e-9)
+            for name in sorted(spans):
+                if name in claimed:
+                    continue
+                total = sum(_dur_s(e) for e in spans[name])
+                cands.append(
+                    {
+                        "name": f"span:{name}",
+                        "score": _r(min(1.0, total / wall)),
+                        "spans": [name],
+                        "evidence": f"{total:.3f}s across "
+                        f"{len(spans[name])} spans",
+                        "suggestion": "inspect this track in Perfetto",
+                    }
+                )
+    cands = [c for c in cands if (c["score"] or 0) > 0]
+    cands.sort(key=lambda c: (-c["score"], c["name"]))
+    top = cands[:3]
+    for i, c in enumerate(top):
+        c["rank"] = i + 1
+        c["tune_ops"] = _TUNE_OPS.get(c["name"], [])
+    return top
+
+
+# ---------------------------------------------------------------------------
+# Public API
+# ---------------------------------------------------------------------------
+
+
+def analyze_events(
+    events: list[dict],
+    events_path: str | None = None,
+    trace_health: dict | None = None,
+) -> dict:
+    """Chrome events (+ optional events JSONL path) → the report dict."""
+    spans = _spans_by_name(events)
+    counters = _counters_by_name(events)
+    steps = _steps_section(spans)
+    pipeline = {
+        "eval": _overlap_section(
+            spans, "detect_dispatch", "detect_fetch", "eval_convert"
+        ),
+        "serve": _overlap_section(
+            spans, "serve_dispatch", "serve_fetch", "serve_convert"
+        ),
+    }
+    queues = _queue_section(counters, spans.get("data_wait") or [])
+    events_section = _events_section(events_path)
+    run_meta = _instants(events, "run_meta")
+    meta_args = (run_meta[-1].get("args") or {}) if run_meta else {}
+    device_kind = meta_args.get("device_kind") or (
+        events_section.get("header", {}).get("device_kind")
+        if events_section.get("available")
+        else None
+    )
+    report = {
+        "schema_version": SCHEMA_VERSION,
+        "source": {
+            "device_kind": device_kind,
+            "local_device_count": meta_args.get("local_device_count"),
+            "process_count": meta_args.get("process_count"),
+            "events": bool(events_section.get("available")),
+            "trace_events": len(events),
+        },
+        "steps": steps,
+        "pipeline": pipeline,
+        "queues": queues,
+        "memory": _memory_section(counters),
+        "mfu": _mfu_section(events, steps, device_kind),
+        "stalls": _stalls_section(events, events_section),
+        "events": events_section,
+        "span_stats": _span_stats(spans),
+        "bottlenecks": _bottlenecks(steps, pipeline, spans, queues),
+        "health": dict(trace_health or {}),
+    }
+    return report
+
+
+def analyze_dir(
+    obs_dir: str,
+    trace_name: str = "trace.json",
+    events_name: str | None = "metrics.jsonl",
+) -> dict:
+    """The offline entrypoint: an obs dir (as left by a --obs-trace run)
+    → the report dict.  The trace is required; the events JSONL is
+    enrichment (MFU falls back to trace instants, run metadata degrades
+    to None).  ``events_name=None`` skips the JSONL entirely — the bench
+    emitters use this: bench never writes events, and a shared obs dir
+    may hold a PREVIOUS train run's metrics.jsonl whose header/compile
+    records must not be attributed to this trace."""
+    trace_path = os.path.join(obs_dir, trace_name)
+    events, health = load_trace(trace_path)
+    events_path = (
+        os.path.join(obs_dir, events_name) if events_name else None
+    )
+    report = analyze_events(
+        events,
+        events_path=events_path
+        if events_path and os.path.exists(events_path)
+        else None,
+        trace_health=health,
+    )
+    report["source"]["trace"] = trace_name
+    return report
+
+
+def span_attribution(events: list[dict]) -> dict | None:
+    """Compact attribution for an in-process event snapshot — the piece
+    ``bench.py --trace`` folds into its committed JSON line so the
+    BENCH_rNN trajectory carries data_wait%/overlap% history, not bare
+    imgs/s.  None when there is nothing to attribute."""
+    spans = _spans_by_name(events)
+    all_spans = [e for group in spans.values() for e in group]
+    if not all_spans:
+        return None
+    wall = max(_end_s(e) for e in all_spans) - min(
+        _start_s(e) for e in all_spans
+    )
+    wall = max(wall, 1e-9)
+    by_span = {
+        name: _r(sum(_dur_s(e) for e in group), 4)
+        for name, group in sorted(spans.items())
+    }
+    steps = _steps_section(spans)
+    out: dict[str, Any] = {
+        "wall_s": _r(wall, 3),
+        "by_span_s": by_span,
+        "decomposition": steps["decomposition"] if steps else None,
+    }
+    overlap = {}
+    for key, names in (
+        ("eval", ("detect_dispatch", "detect_fetch", "eval_convert")),
+        ("serve", ("serve_dispatch", "serve_fetch", "serve_convert")),
+    ):
+        sec = _overlap_section(spans, *names)
+        if sec is not None:
+            overlap[key] = sec["overlap_efficiency"]
+    out["overlap_efficiency"] = overlap or None
+    return out
+
+
+def write_report(report: dict, path: str) -> str:
+    """Serialize deterministically (sorted keys, trailing newline) so the
+    inline and offline emitters produce byte-identical files."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def validate_report(report: Any) -> list[str]:
+    """Structural schema check → list of problems (empty = valid).  Used
+    by the CLI, perf-report-check, and the fixture tests."""
+    problems: list[str] = []
+    if not isinstance(report, dict):
+        return ["report is not an object"]
+    if report.get("schema_version") != SCHEMA_VERSION:
+        problems.append(
+            f"schema_version {report.get('schema_version')!r} != "
+            f"{SCHEMA_VERSION}"
+        )
+    for key in (
+        "source",
+        "steps",
+        "pipeline",
+        "queues",
+        "memory",
+        "mfu",
+        "stalls",
+        "events",
+        "span_stats",
+        "bottlenecks",
+        "health",
+    ):
+        if key not in report:
+            problems.append(f"missing section {key!r}")
+    steps = report.get("steps")
+    if isinstance(steps, dict):
+        d = steps.get("decomposition")
+        if not isinstance(d, dict) or set(d) != set(_DECOMP_KEYS):
+            problems.append("steps.decomposition keys wrong")
+        else:
+            if any(
+                not isinstance(v, (int, float)) or v < 0 or v > 1
+                for v in d.values()
+            ):
+                problems.append("steps.decomposition fraction out of [0,1]")
+            elif abs(sum(d.values()) - 1.0) > 0.02:
+                problems.append(
+                    f"steps.decomposition sums to {sum(d.values()):.4f}, "
+                    "not ~1"
+                )
+    bn = report.get("bottlenecks")
+    if not isinstance(bn, list):
+        problems.append("bottlenecks is not a list")
+    else:
+        for i, b in enumerate(bn):
+            if not isinstance(b, dict) or not {
+                "rank",
+                "name",
+                "score",
+                "spans",
+            } <= set(b):
+                problems.append(f"bottlenecks[{i}] malformed")
+            elif b.get("rank") != i + 1:
+                problems.append(f"bottlenecks[{i}] rank out of order")
+    mfu = report.get("mfu")
+    if isinstance(mfu, dict):
+        missing = {"flops_per_step", "peak_tflops", "mfu"} - set(mfu)
+        if missing:
+            problems.append(f"mfu missing {sorted(missing)}")
+    else:
+        problems.append("mfu is not an object")
+    return problems
+
+
+def auto_emit(
+    obs_dir: str,
+    trace_name: str = "trace.json",
+    out_name: str = "PERF_REPORT.json",
+    sink: Any | None = None,
+    events_name: str | None = "metrics.jsonl",
+) -> str | None:
+    """The finalize-path hook (train.py / bench.py): analyze + write the
+    report next to the trace.  NEVER raises — a run that trained for
+    hours must not die in its post-mortem; failure is ONE structured
+    ``perf_report_error`` event (to ``sink`` when given, and stderr
+    either way)."""
+    try:
+        report = analyze_dir(
+            obs_dir, trace_name=trace_name, events_name=events_name
+        )
+        return write_report(report, os.path.join(obs_dir, out_name))
+    except Exception as e:
+        if sink is not None:
+            try:
+                sink.event(
+                    "perf_report_error", obs_dir=obs_dir, error=repr(e)[:500]
+                )
+            except Exception:
+                pass  # the stderr line below still lands
+        print(
+            json.dumps(
+                {
+                    "event": "perf_report_error",
+                    "obs_dir": obs_dir,
+                    "error": repr(e)[:500],
+                }
+            ),
+            file=sys.stderr,
+            flush=True,
+        )
+        return None

@@ -68,6 +68,25 @@ def scalarize(metrics: Mapping[str, Any]) -> tuple[dict[str, float], list[str]]:
     return out, dropped
 
 
+def latency_percentiles(
+    values_ms, ps: tuple[int, ...] = (50, 90, 99)
+) -> dict[str, float]:
+    """THE p50/p99 implementation (ISSUE 8 satellite): one summary shape
+    shared by ``EventSink.histogram``, the serve ``LatencyStats`` snapshot
+    and the obs/analyze span statistics, so their quantile semantics
+    (numpy linear interpolation) can never drift.  Empty input → ``{}``
+    (callers skip the record)."""
+    arr = np.asarray(list(values_ms), dtype=np.float64)
+    if arr.size == 0:
+        return {}
+    out: dict[str, float] = {"count": int(arr.size)}
+    for p in ps:
+        out[f"p{p}_ms"] = round(float(np.percentile(arr, p)), 3)
+    out["mean_ms"] = round(float(arr.mean()), 3)
+    out["max_ms"] = round(float(arr.max()), 3)
+    return out
+
+
 def _git_rev() -> str | None:
     try:
         r = subprocess.run(
@@ -265,26 +284,23 @@ class EventSink:
     def histogram(
         self, name: str, values_ms, step: int | None = None
     ) -> None:
-        """One latency-distribution record: p50/p90/p99/max over a window
-        of millisecond samples (the serve frontend's per-window request
-        latencies; any bounded sample list works).  Quantiles are computed
-        here — the sink is the cold path — so callers just hand over the
-        raw window."""
+        """One latency-distribution record: p50/p90/p99/mean/max over a
+        window of millisecond samples (the serve frontend's per-window
+        request latencies; any bounded sample list works).  Quantiles are
+        computed here — the sink is the cold path — so callers just hand
+        over the raw window; the math is ``latency_percentiles``, shared
+        with the serve stats and the obs/analyze span statistics."""
         if not self._enabled or not self._jsonl:
             return
-        arr = np.asarray(list(values_ms), dtype=np.float64)
-        if arr.size == 0:
+        summary = latency_percentiles(values_ms)
+        if not summary:
             return
         rec = {
             "event": "histogram",
             "wall_s": round(trace.monotonic_s() - self._t0, 3),
             "name": name,
-            "count": int(arr.size),
-            "p50_ms": round(float(np.percentile(arr, 50)), 3),
-            "p90_ms": round(float(np.percentile(arr, 90)), 3),
-            "p99_ms": round(float(np.percentile(arr, 99)), 3),
-            "max_ms": round(float(arr.max()), 3),
         }
+        rec.update(summary)
         if step is not None:
             rec["step"] = step
         self._write(rec)

@@ -362,6 +362,16 @@ def _chrome_events() -> Iterator[dict]:
             yield ev
 
 
+def snapshot_events() -> list[dict]:
+    """This process's recorded events as Chrome ``trace_event`` dicts —
+    the export payload without the file.  The inline analysis hooks
+    (``obs.analyze.span_attribution`` in ``bench.py --trace``) read the
+    live rings through this; empty while tracing is disabled."""
+    if not _enabled:
+        return []
+    return list(_chrome_events())
+
+
 def export(path: str | None = None) -> str | None:
     """Write this process's events as one Chrome-trace JSON file.
 
@@ -378,7 +388,7 @@ def export(path: str | None = None) -> str | None:
         )
     dropped = sum(r.dropped for r in _rings)
     doc = {
-        "traceEvents": list(_chrome_events()),
+        "traceEvents": snapshot_events(),
         "displayTimeUnit": "ms",
         "otherData": {
             "process_label": _process_label,

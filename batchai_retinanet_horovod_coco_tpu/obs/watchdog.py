@@ -48,6 +48,7 @@ import sys
 import threading
 from typing import Any, Callable
 
+from batchai_retinanet_horovod_coco_tpu.obs import trace
 from batchai_retinanet_horovod_coco_tpu.obs.trace import monotonic_s
 
 
@@ -202,6 +203,15 @@ class Watchdog:
         }
 
     def _dump(self, diag: dict) -> None:
+        # Perfetto marker (ISSUE 8 satellite): the stall is visible ON the
+        # timeline at the instant it fired — lined up against whatever the
+        # other tracks were (not) doing — instead of only in the JSONL
+        # record and watchdog_stacks.txt.  No-op while tracing is off.
+        trace.instant(
+            "stall",
+            component=diag["component"],
+            stalled_for_s=diag["stalled_for_s"],
+        )
         line = json.dumps({"event": "watchdog_stall", **diag})
         print(line, file=sys.stderr, flush=True)
         if self.dump_path:

@@ -28,6 +28,7 @@ from typing import Any, NamedTuple
 
 import numpy as np
 
+from batchai_retinanet_horovod_coco_tpu.obs.events import latency_percentiles
 from batchai_retinanet_horovod_coco_tpu.obs.trace import monotonic_s
 
 
@@ -230,12 +231,17 @@ class LatencyStats:
                 "shed_total": sum(self.shed.values()),
             }
         if lat:
-            arr = np.asarray(lat, dtype=np.float64) * 1e3
+            # One quantile implementation across the repo (ISSUE 8
+            # satellite): the shared helper in obs/events.py; only the
+            # historical "window" key name differs from its "count".
+            pct = latency_percentiles(
+                np.asarray(lat, dtype=np.float64) * 1e3, ps=(50, 99)
+            )
             out.update(
-                p50_ms=round(float(np.percentile(arr, 50)), 3),
-                p99_ms=round(float(np.percentile(arr, 99)), 3),
-                mean_ms=round(float(arr.mean()), 3),
-                max_ms=round(float(arr.max()), 3),
-                window=len(lat),
+                p50_ms=pct["p50_ms"],
+                p99_ms=pct["p99_ms"],
+                mean_ms=pct["mean_ms"],
+                max_ms=pct["max_ms"],
+                window=pct["count"],
             )
         return out

@@ -261,6 +261,27 @@ class _AsyncEvalRunner:
             warnings.warn(f"async eval failed during loop unwind: {exc!r}")
 
 
+def _step_cost_flops(step_fn, state, device_arrays) -> float | None:
+    """XLA-counted FLOPs of one train step, from the UNOPTIMIZED lowering
+    (``Lowered.cost_analysis`` — tracing cost only, no second backend
+    compile).  Feeds the ``cost_analysis`` trace instant + compile event
+    the perf doctor's MFU/roofline estimate reads (obs/analyze), so the
+    number exists per RUN, not only per bench.  None when the step
+    wrapper has no AOT surface or the backend offers no cost analysis —
+    the report then carries ``mfu: null`` instead of a guess."""
+    lower = getattr(step_fn, "lower", None)
+    if lower is None:
+        return None
+    try:
+        cost = lower(state, device_arrays).cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0] if cost else None
+        flops = float(cost.get("flops", 0.0)) if cost else 0.0
+    except Exception:
+        return None
+    return flops if flops > 0 else None
+
+
 def _compile_barrier(step_fn, state, device_arrays, hw) -> None:
     """Compile the step, then barrier at the COORDINATION SERVICE before
     its first execution on multi-process runs.
@@ -417,6 +438,20 @@ def run_training(
         else:
             state = jax.device_put(state, replicated_sharding(mesh))
 
+    if trace.enabled():
+        # Run metadata INTO the trace (the perf doctor resolves device
+        # peak TFLOP/s and process topology from artifacts alone — the
+        # events JSONL may not exist for this run).
+        try:
+            trace.instant(
+                "run_meta",
+                device_kind=jax.devices()[0].device_kind,
+                local_device_count=jax.local_device_count(),
+                process_count=jax.process_count(),
+            )
+        except Exception:
+            pass  # metadata must never block training bring-up
+
     step_fns: dict[tuple[int, int], Callable] = {}
     start_step = int(state.step)
     last_saved: int | None = None
@@ -506,6 +541,22 @@ def run_training(
                     # peer is still compiling (collective timeouts <<
                     # compile times).
                     _compile_barrier(step_fn, state, device_arrays, hw)
+                    # Obs runs also record the step's XLA-counted FLOPs
+                    # (one extra trace of the step, no extra compile) so
+                    # PERF_REPORT.json can carry an MFU estimate.
+                    flops = (
+                        _step_cost_flops(step_fn, state, device_arrays)
+                        if trace.enabled()
+                        else None
+                    )
+                    if flops is not None:
+                        trace.instant(
+                            "cost_analysis",
+                            target="train_step",
+                            bucket=f"{hw[0]}x{hw[1]}",
+                            flops=flops,
+                            batch=int(images_shape[0]),
+                        )
                 loop_hb.beat()
                 # Duck-typed: tests pass bare .log-only logger fakes.
                 log_event = getattr(logger, "event", None)
@@ -516,6 +567,7 @@ def run_training(
                         bucket=f"{hw[0]}x{hw[1]}",
                         step=step,
                         build_s=round(monotonic_s() - t_compile, 3),
+                        flops=flops,
                     )
             if config.profile_dir and step == prof_start:
                 jax.profiler.start_trace(config.profile_dir)

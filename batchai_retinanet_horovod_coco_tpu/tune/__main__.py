@@ -98,6 +98,45 @@ def _emit_unreachable(phase: str, error: str, bench_out: str) -> int:
     return EXIT_TPU_UNREACHABLE
 
 
+def _ops_from_report(path: str) -> tuple[list[str], bool]:
+    """PERF_REPORT.json (obs/analyze) → (op families, search batch axis).
+
+    The perf doctor's top-3 bottleneck verdict names the ``tune/``
+    problems to attack (``tune_ops`` per entry: nms/focal/matching/
+    batch); this is the loop-closing consumer — ``--from-report`` turns a
+    run's own attribution into the next search instead of a hand-picked
+    --ops list.  Ops come back deduplicated in rank order; ``batch``
+    maps onto the --batch-axis search rather than an op family.
+    Raises SystemExit on an unreadable report or an empty verdict (an
+    explicit "nothing tunable" beats silently searching everything).
+    """
+    ops: list[str] = []
+    names: list[str] = []
+    batch_axis = False
+    try:
+        with open(path) as f:
+            report = json.load(f)
+        # TypeError/AttributeError cover structurally-wrong JSON (a
+        # top-level array, string entries): every malformation gets the
+        # same friendly SystemExit, never a raw traceback.
+        for b in report["bottlenecks"]:
+            names.append(str(b.get("name")))
+            for op in b.get("tune_ops") or []:
+                if op == "batch":
+                    batch_axis = True
+                elif op not in ops:
+                    ops.append(op)
+    except (OSError, ValueError, KeyError, TypeError, AttributeError) as e:
+        raise SystemExit(f"--from-report: cannot read {path!r}: {e}")
+    if not ops and not batch_axis:
+        raise SystemExit(
+            f"--from-report: {path!r} names no tunable ops in its top-3 "
+            f"verdict ({names}) — nothing for the tuner to attack; run "
+            "the search explicitly with --ops"
+        )
+    return ops, batch_axis
+
+
 def _parse_hw(text: str) -> tuple[int, int]:
     try:
         h, w = text.lower().split("x")
@@ -163,12 +202,20 @@ def main(argv: list[str] | None = None) -> int:
         description="measured schedule search → per-device registry artifact",
     )
     ap.add_argument(
-        "--ops", default="nms,focal,matching",
-        help="comma list of op families to search (nms,focal,matching)",
+        "--ops", default=None,
+        help="comma list of op families to search (default "
+             "nms,focal,matching, or the --from-report verdict)",
     )
     ap.add_argument(
         "--batch-axis", action="store_true",
         help="also search per-bucket batch sizes (eval/serve tables)",
+    )
+    ap.add_argument(
+        "--from-report", default=None, metavar="PERF_REPORT.json",
+        help="derive the search from a perf-doctor report's top-3 "
+             "bottleneck verdict (obs/analyze): the union of its "
+             "tune_ops in rank order; a 'batch' op enables --batch-axis. "
+             "An explicit --ops overrides",
     )
     ap.add_argument("--hw", default=None, metavar="HxW",
                     help="bucket to measure at (default: flagship 800x1344)")
@@ -204,6 +251,18 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--obs-dir", default="artifacts/obs",
                     help="where --trace writes its artifacts")
     args = ap.parse_args(argv)
+
+    if args.from_report is not None and args.ops is None:
+        report_ops, report_batch_axis = _ops_from_report(args.from_report)
+        args.ops = ",".join(report_ops)
+        args.batch_axis = args.batch_axis or report_batch_axis
+        print(
+            f"# tune: --from-report {args.from_report} -> "
+            f"ops={args.ops or '(none)'} batch_axis={args.batch_axis}",
+            flush=True,
+        )
+    if args.ops is None:
+        args.ops = "nms,focal,matching"
 
     # Smoke defaults: small enough that a 2-vCPU box finishes in seconds.
     hw = _parse_hw(args.hw) if args.hw else ((256, 256) if args.smoke else None)

@@ -372,6 +372,11 @@ def main(argv=None) -> dict[str, float]:
     obs_dir = configure_obs(args, process_label="train")
     if obs_dir is None:
         return _run(args)
+    if not args.log_dir:
+        # The perf doctor (obs/analyze) reads the run's events JSONL next
+        # to its trace: an obs-enabled run without an explicit --log-dir
+        # logs into the obs dir so the report never lacks its events half.
+        args.log_dir = obs_dir
     try:
         return _run(args)
     finally:
@@ -381,6 +386,34 @@ def main(argv=None) -> dict[str, float]:
         if merged:
             print(f"obs: merged Chrome trace at {merged} "
                   "(load in Perfetto / chrome://tracing)", flush=True)
+            # Auto-emit PERF_REPORT.json next to the trace.  Analysis can
+            # never crash the run: auto_emit swallows its own failures
+            # into ONE structured perf_report_error line, and the import
+            # is guarded for the same reason.
+            try:
+                from batchai_retinanet_horovod_coco_tpu.obs.analyze import (
+                    auto_emit,
+                )
+
+                report = auto_emit(obs_dir)
+            except Exception as e:  # never mask the run's own outcome
+                import json as _json
+
+                print(
+                    _json.dumps(
+                        {"event": "perf_report_error", "error": repr(e)[:500]}
+                    ),
+                    file=sys.stderr,
+                    flush=True,
+                )
+                report = None
+            if report:
+                print(
+                    f"obs: perf report at {report} (reproduce offline: "
+                    "python -m batchai_retinanet_horovod_coco_tpu.obs."
+                    f"analyze {obs_dir})",
+                    flush=True,
+                )
 
 
 def _run(args) -> dict[str, float]:

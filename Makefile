@@ -22,7 +22,7 @@ CLUSTER = python -m batchai_retinanet_horovod_coco_tpu.launch.cluster
 	bench-check bench-pipeline pipebench pipebench-check evalbench \
 	evalbench-check servebench servebench-check canaries \
 	convergence-full lint lint-obs check-static tune-smoke tunebench \
-	tunebench-check perf-report perf-report-check
+	tunebench-check perf-report perf-report-check telemetry-smoke
 
 create:
 	$(CLUSTER) create --name $(NAME) --zone $(ZONE) --accelerator $(ACCEL) $(DRYFLAG)
@@ -69,6 +69,7 @@ bench-check:
 	BENCH_SWEEP=0 EVALBENCH_E2E=0 BENCH_CHECK=1 python bench.py --mode eval
 	BENCH_SWEEP=0 SERVEBENCH_OVERLOAD=0 BENCH_CHECK=1 python bench.py --mode serve
 	$(MAKE) perf-report-check
+	$(MAKE) telemetry-smoke
 
 # Eval/detect fast-path bench (ISSUE 2): per-bucket AOT detect + NMS-only
 # ms/batch + sequential-vs-pipelined end-to-end comparison, one JSON line.
@@ -116,10 +117,20 @@ lint:
 	python scripts/audit_threads.py
 	python scripts/audit_collectives.py --reduced --devices 2
 
+# Live telemetry smoke (ISSUE 9): CPU serve smoke over a stub engine →
+# scrape + schema-check GET /metrics (request-latency summary, shed
+# counters, queue-depth gauges, Prometheus text format) and GET /healthz
+# (200 live → 503 naming the stalled component under an injected
+# watchdog stall → recovery), plus the registry-vs-snapshot consistency
+# check.  No chip, no dataset — CI-safe; also aggregated into
+# check-static and bench-check.
+telemetry-smoke:
+	JAX_PLATFORMS=cpu python scripts/telemetry_smoke.py
+
 # bench-check-style aggregate for everything static: one target CI can run
 # without touching a chip or a dataset.
-check-static: lint
-	@echo "check-static: lint engine + watchdog audit + HLO collective audit all green"
+check-static: lint telemetry-smoke
+	@echo "check-static: lint engine + watchdog audit + HLO collective audit + telemetry smoke all green"
 
 # Static watchdog-coverage audit alone (ISSUE 3; now a shim over the lint
 # engine's watchdog-coverage rule — same CLI, same exit codes).  Also runs

@@ -13,6 +13,16 @@ Three coordinated pieces:
   registers with, and the stall diagnoser that dumps the post-mortem
   before a timeout kills the run.
 
+Two later additions ride on those three (ISSUE 9, imported lazily by
+their consumers so the core trio stays import-light):
+
+- ``obs.telemetry`` — the live metrics registry (counters/gauges/
+  windowed histograms), Prometheus text exposition, watchdog-backed
+  ``healthz``, and the drain-safe HTTP status server behind
+  ``train.py --obs-port`` and the serve frontend's ``GET /metrics``;
+- ``obs.slo`` — the declarative SLO monitor evaluating rules on that
+  registry and emitting ``slo_violation`` events/trace instants.
+
 ``enable``/``finalize`` are the run-scoped bring-up/teardown the CLI
 flags (``--obs-trace``/``--obs-dir``, utils/cli.py) call; everything in
 between is always-on instrumentation that costs nothing while disabled.
@@ -31,7 +41,20 @@ from batchai_retinanet_horovod_coco_tpu.obs import trace
 from batchai_retinanet_horovod_coco_tpu.obs import watchdog
 from batchai_retinanet_horovod_coco_tpu.obs import events
 
-__all__ = ["trace", "watchdog", "events", "enable", "finalize"]
+__all__ = [
+    "trace", "watchdog", "events", "telemetry", "slo", "enable", "finalize",
+]
+
+
+def __getattr__(name: str):
+    # Lazy submodule access (``obs.telemetry`` / ``obs.slo``): keeps the
+    # package's import-time surface exactly the PR-3 trio for jax-free
+    # worker processes that only need trace/watchdog/events.
+    if name in ("telemetry", "slo"):
+        import importlib
+
+        return importlib.import_module(f"{__name__}.{name}")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def enable(

@@ -27,7 +27,15 @@ function from a run's own artifacts to
   bench;
 - **a ranked top-3 bottleneck verdict** — each entry names the spans to
   stare at in Perfetto and the ``tune/`` problems (``nms``, ``focal``,
-  ``matching``, ``batch``) the next optimization PR should search.
+  ``matching``, ``batch``) the next optimization PR should search;
+- **an SLO violations section** (ISSUE 9, schema v2) — the
+  ``slo_violation`` events the live monitor (obs/slo.py) emitted, read
+  from BOTH the events JSONL and the trace's instant markers and
+  aggregated per rule.  A violated SLO is a breach someone *declared*
+  they care about, so it outranks every inferred bottleneck: each
+  violated rule contributes a ``slo:<rule>`` verdict at the head of the
+  ranking (score 1.0), with tune ops mapped from the breached metric so
+  ``tune --from-report`` still closes the loop.
 
 Determinism contract: the report is a pure function of the artifact
 files — no wall clocks, no environment probes (the peak-TFLOPs env
@@ -54,7 +62,8 @@ from batchai_retinanet_horovod_coco_tpu.obs.events import (
     split_runs,
 )
 
-SCHEMA_VERSION = 1
+# v2 (ISSUE 9): + the ``violations`` section and its slo:* verdicts.
+SCHEMA_VERSION = 2
 
 # Peak dense bf16 TFLOP/s per chip by device kind (public spec sheets) —
 # THE table, shared with bench.py's MFU line (one source of truth).
@@ -524,6 +533,64 @@ def _mfu_section(
     return out
 
 
+def _violations_section(
+    events: list[dict], events_path: str | None
+) -> dict:
+    """The SLO read-back: ``slo_violation`` trace instants + JSONL events
+    aggregated per rule.  The JSONL records are the richer source (they
+    carry the description); the trace markers stand in when a run had no
+    events half — per-rule aggregates prefer whichever source saw more
+    of that rule (the monitor emits to both, so counts normally agree).
+    """
+    trace_v = [
+        dict(e.get("args") or {}) for e in _instants(events, "slo_violation")
+    ]
+    jsonl_v: list[dict] = []
+    if events_path and os.path.exists(events_path):
+        try:
+            runs = split_runs(events_path)
+        except OSError:
+            runs = []
+        if runs:
+            jsonl_v = [
+                r
+                for r in runs[-1].get("records", [])
+                if r.get("event") == "slo_violation"
+            ]
+    rules: dict[str, dict] = {}
+    for source in (jsonl_v, trace_v):
+        counts: dict[str, int] = {}
+        for v in source:
+            name = str(v.get("rule") or "?")
+            counts[name] = counts.get(name, 0) + 1
+            agg = rules.setdefault(
+                name,
+                {
+                    "count": 0,
+                    "metric": v.get("metric"),
+                    "op": v.get("op"),
+                    "max_sustained_s": 0.0,
+                    "last_value": None,
+                    "threshold": None,
+                    "description": v.get("description"),
+                },
+            )
+            agg["max_sustained_s"] = max(
+                agg["max_sustained_s"], float(v.get("sustained_s") or 0.0)
+            )
+            agg["last_value"] = v.get("value")
+            agg["threshold"] = v.get("threshold")
+            if v.get("description"):
+                agg["description"] = v.get("description")
+        for name, n in counts.items():
+            rules[name]["count"] = max(rules[name]["count"], n)
+    return {
+        "trace_markers": len(trace_v),
+        "jsonl_events": len(jsonl_v),
+        "rules": {k: rules[k] for k in sorted(rules)},
+    }
+
+
 def _stalls_section(events: list[dict], events_section: dict) -> dict:
     markers = _instants(events, "stall")
     components: dict[str, int] = {}
@@ -550,16 +617,38 @@ _TUNE_OPS = {
 }
 
 
+def _slo_tune_ops(metric: str | None) -> list[str]:
+    """Breached metric → the tune/ problems that attack it, so an SLO
+    verdict at rank 1 still gives ``tune --from-report`` something to
+    search (a stall/shed rule maps to nothing — those are capacity or
+    wedge problems, not kernel-schedule problems)."""
+    m = (metric or "").lower()
+    if "latency" in m or "p99" in m or "p50" in m:
+        return ["nms", "batch"]
+    if "step_time" in m or "images_per_sec" in m:
+        return ["focal", "matching", "nms"]
+    if "data_wait" in m:
+        return ["batch"]
+    return []
+
+
 def _bottlenecks(
     steps: dict | None,
     pipeline: dict,
     spans: dict[str, list[dict]],
     queues: dict,
+    violations: dict | None = None,
 ) -> list[dict]:
     """Ranked verdicts, scores all expressed as fractions of the main
     window so they are mutually comparable.  Non-empty whenever the trace
     carries any span at all (the generic fallback ranks raw span
-    families when the train vocabulary is absent — bench traces)."""
+    families when the train vocabulary is absent — bench traces).
+
+    SLO violations outrank everything inferred: a breach of a DECLARED
+    objective is evidence by fiat, so each violated rule contributes a
+    ``slo:<rule>`` verdict at score 1.0 (inferred scores are window
+    fractions ≤ 1) ON TOP of the top-3 inferred verdicts — the inferred
+    ranking is never starved out of the report by a noisy SLO."""
     cands: list[dict] = []
     if steps is not None:
         d = steps["decomposition"]
@@ -710,9 +799,35 @@ def _bottlenecks(
     cands = [c for c in cands if (c["score"] or 0) > 0]
     cands.sort(key=lambda c: (-c["score"], c["name"]))
     top = cands[:3]
+    for c in top:
+        c["tune_ops"] = _TUNE_OPS.get(c["name"], [])
+    vio_cands: list[dict] = []
+    for name, info in sorted(
+        ((violations or {}).get("rules") or {}).items()
+    ):
+        vio_cands.append(
+            {
+                "name": f"slo:{name}",
+                "score": 1.0,
+                "spans": ["slo_violation"],
+                "evidence": (
+                    f"SLO {name!r} violated {info['count']}x "
+                    f"({info.get('metric')} {info.get('op') or '>'} "
+                    f"{info.get('threshold')}, last value "
+                    f"{info.get('last_value')}, sustained "
+                    f"{info.get('max_sustained_s')}s)"
+                ),
+                "suggestion": (
+                    "a violated declared objective outranks inferred "
+                    "bottlenecks: attack the breached metric first "
+                    "(RUNBOOK 'Live telemetry')"
+                ),
+                "tune_ops": _slo_tune_ops(info.get("metric")),
+            }
+        )
+    top = vio_cands + top
     for i, c in enumerate(top):
         c["rank"] = i + 1
-        c["tune_ops"] = _TUNE_OPS.get(c["name"], [])
     return top
 
 
@@ -740,6 +855,7 @@ def analyze_events(
     }
     queues = _queue_section(counters, spans.get("data_wait") or [])
     events_section = _events_section(events_path)
+    violations = _violations_section(events, events_path)
     run_meta = _instants(events, "run_meta")
     meta_args = (run_meta[-1].get("args") or {}) if run_meta else {}
     device_kind = meta_args.get("device_kind") or (
@@ -762,9 +878,12 @@ def analyze_events(
         "memory": _memory_section(counters),
         "mfu": _mfu_section(events, steps, device_kind),
         "stalls": _stalls_section(events, events_section),
+        "violations": violations,
         "events": events_section,
         "span_stats": _span_stats(spans),
-        "bottlenecks": _bottlenecks(steps, pipeline, spans, queues),
+        "bottlenecks": _bottlenecks(
+            steps, pipeline, spans, queues, violations
+        ),
         "health": dict(trace_health or {}),
     }
     return report
@@ -863,6 +982,7 @@ def validate_report(report: Any) -> list[str]:
         "memory",
         "mfu",
         "stalls",
+        "violations",
         "events",
         "span_stats",
         "bottlenecks",
@@ -870,6 +990,11 @@ def validate_report(report: Any) -> list[str]:
     ):
         if key not in report:
             problems.append(f"missing section {key!r}")
+    violations = report.get("violations")
+    if not isinstance(violations, dict) or not isinstance(
+        violations.get("rules"), dict
+    ):
+        problems.append("violations section malformed (needs a rules map)")
     steps = report.get("steps")
     if isinstance(steps, dict):
         d = steps.get("decomposition")

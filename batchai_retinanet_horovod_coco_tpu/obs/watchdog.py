@@ -148,6 +148,32 @@ class Watchdog:
         with self._lock:
             return {n: now - c.last_beat for n, c in self._components.items()}
 
+    def stalled_components(self, now: float | None = None) -> list[dict]:
+        """READ-ONLY stall probe (the telemetry /healthz verdict,
+        obs/telemetry.py): every non-idle component currently past its
+        stall budget, most-stalled first.  Unlike ``check_once`` it never
+        touches the one-dump-per-stall ``warned`` latch — a health scrape
+        must not eat the poll thread's diagnosis."""
+        now = monotonic_s() if now is None else now
+        with self._lock:
+            comps = list(self._components.values())
+        out = []
+        for c in comps:
+            if c.idle:
+                continue
+            budget = c.stall_after or self.stall_after
+            age = now - c.last_beat
+            if age > budget:
+                out.append(
+                    {
+                        "component": c.name,
+                        "stalled_for_s": round(age, 3),
+                        "stall_after_s": budget,
+                    }
+                )
+        out.sort(key=lambda d: (-d["stalled_for_s"], d["component"]))
+        return out
+
     # ---- stall detection -------------------------------------------------
 
     def _snapshot(self, now: float) -> list[dict]:

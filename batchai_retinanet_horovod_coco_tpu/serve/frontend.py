@@ -36,6 +36,14 @@ Contracts (pinned by tests/unit/test_serve.py):
   plus a cross-thread ``serve_request`` span per request; queue-depth
   counters; a watchdog heartbeat on every serve thread; periodic
   ``serve_stats`` events (p50/p99, sheds) into the obs event sink.
+- **Live telemetry** (ISSUE 9): every server carries a pull-only
+  metrics registry (``self.telemetry``, obs/telemetry.py — collectors
+  over the same snapshot/LatencyStats the /stats payload reads, zero
+  new hot-path work) exposed as ``GET /metrics`` (Prometheus text);
+  ``GET /healthz`` is split from ``/stats`` and is TRUTHFUL — 503
+  naming the stalled component whenever the watchdog registry reports
+  a non-idle component past its stall budget — and carries the
+  per-replica load fields the fleet router will weigh on.
 """
 
 from __future__ import annotations
@@ -51,7 +59,7 @@ import numpy as np
 from batchai_retinanet_horovod_coco_tpu.evaluate.detect import (
     detections_to_coco,
 )
-from batchai_retinanet_horovod_coco_tpu.obs import trace, watchdog
+from batchai_retinanet_horovod_coco_tpu.obs import telemetry, trace, watchdog
 from batchai_retinanet_horovod_coco_tpu.obs.trace import monotonic_s
 from batchai_retinanet_horovod_coco_tpu.serve.batcher import BucketBatcher
 from batchai_retinanet_horovod_coco_tpu.serve.common import (
@@ -61,6 +69,7 @@ from batchai_retinanet_horovod_coco_tpu.serve.common import (
     RequestRejected,
     RequestTimeout,
     ServeConfig,
+    ServeError,
     ServeRequest,
     ServerClosed,
     ServerError,
@@ -86,6 +95,18 @@ class DetectionServer:
         self.config = config
         self.sink = sink
         self.stats = LatencyStats(window=config.latency_window)
+        # The live-telemetry registry (ISSUE 9): pull-only — quantiles
+        # read the LatencyStats window and the collector reads the same
+        # snapshot() the /stats payload serves, all at scrape time, so
+        # the request hot path pays nothing for /metrics existing.
+        self.telemetry = telemetry.Registry()
+        self.telemetry.histogram(
+            "serve_request_latency_ms",
+            "request latency over the recent window (accepted requests)",
+            source=self.stats.window_ms,
+        )
+        self.telemetry.register_collector(self._telemetry_samples)
+        self.telemetry.register_collector(telemetry.watchdog_collector())
         if warmup:
             engine.warmup()
 
@@ -196,6 +217,60 @@ class DetectionServer:
         snap["batches"] = self._batches_done
         snap["deadline_fires"] = sum(b.deadline_fires for b in self._batchers)
         return snap
+
+    def _telemetry_samples(self):
+        """Scrape-time collector: the snapshot() fields as Prometheus
+        families (counters for lifetime totals, gauges for live depths)."""
+        snap = self.snapshot()
+        yield ("serve_requests_completed_total", "counter",
+               "requests completed successfully", None, snap["completed"])
+        yield ("serve_requests_timeout_total", "counter",
+               "requests that expired past their deadline", None,
+               snap["timeouts"])
+        yield ("serve_requests_failed_total", "counter",
+               "requests failed by a server error", None, snap["failed"])
+        for reason, n in sorted(snap["shed"].items()):
+            yield ("serve_shed_total", "counter",
+                   "requests shed by admission control, by reason",
+                   {"reason": reason}, n)
+        yield ("serve_batches_total", "counter",
+               "device batches dispatched", None, snap["batches"])
+        yield ("serve_deadline_fires_total", "counter",
+               "partial batches fired by the coalescing deadline", None,
+               snap["deadline_fires"])
+        yield ("serve_inflight", "gauge",
+               "requests accepted and not yet resolved", None,
+               snap["outstanding"])
+        yield ("serve_queue_depth", "gauge", "live queue depths",
+               {"queue": "admission"}, snap["admission_qsize"])
+        yield ("serve_queue_depth", "gauge", "live queue depths",
+               {"queue": "dispatch"}, snap["dispatch_qsize"])
+        for bucket, depth in sorted(snap["bucket_qsize"].items()):
+            yield ("serve_queue_depth", "gauge", "live queue depths",
+                   {"queue": f"bucket_{bucket}"}, depth)
+        yield ("serve_queue_capacity", "gauge",
+               "configured queue bounds (the shed thresholds)",
+               {"queue": "admission"}, max(1, self.config.admission_queue))
+        yield ("serve_queue_capacity", "gauge",
+               "configured queue bounds (the shed thresholds)",
+               {"queue": "dispatch"}, max(1, self.config.dispatch_depth))
+
+    def load_fields(self) -> dict:
+        """The per-replica load summary the /healthz payload carries —
+        shaped for the serve-fleet weighted router (ROADMAP): in-flight,
+        queue depths vs bounds, and the windowed p99."""
+        snap = self.snapshot()
+        return {
+            "inflight": snap["outstanding"],
+            "admission_qsize": snap["admission_qsize"],
+            "admission_capacity": max(1, self.config.admission_queue),
+            "dispatch_qsize": snap["dispatch_qsize"],
+            "bucket_qsize": snap["bucket_qsize"],
+            "p99_ms": snap.get("p99_ms"),
+            "completed": snap["completed"],
+            "shed_total": snap["shed_total"],
+            "accepting": self._accepting,
+        }
 
     def close(self, drain: bool = True, timeout_s: float | None = None) -> None:
         """Stop accepting, optionally drain in-flight work, stop threads.
@@ -368,7 +443,14 @@ def serve_http(
 
     POST /detect   (body = encoded image)  → 200 JSON detections,
                    503 + reason on shed, 504 on deadline, 500 on crash
-    GET  /stats    → 200 JSON stats snapshot (also /healthz)
+    GET  /stats    → 200 JSON stats snapshot
+    GET  /metrics  → 200 Prometheus text exposition (server.telemetry)
+    GET  /healthz  → TRUTHFUL liveness, split from /stats (ISSUE 9
+                   satellite — it used to be a cosmetic alias): 200 +
+                   per-replica load fields while every watchdog
+                   component is within budget, 503 naming the stalled
+                   component otherwise (read-only probe; the watchdog
+                   poll thread keeps its one-dump-per-stall latch)
 
     ``request_timeout_s`` bounds each handler's wait on its future — an
     HTTP client must never hang on a wedged pipeline (the watchdog names
@@ -388,8 +470,22 @@ def serve_http(
             self.wfile.write(body)
 
         def do_GET(self):  # noqa: N802 (stdlib casing)
-            if self.path in ("/stats", "/healthz"):
+            if self.path == "/stats":
                 self._json(200, server.snapshot())
+            elif self.path == "/healthz":
+                code, payload = telemetry.healthz()
+                payload["load"] = server.load_fields()
+                self._json(code, payload)
+            elif self.path == "/metrics":
+                body = server.telemetry.prometheus_text().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._json(404, {"error": "not_found"})
 
@@ -480,8 +576,47 @@ def main(argv: list[str] | None = None) -> dict:
         f"batch_sizes={ {hw: engine.batch_sizes(hw) for hw in engine.buckets} } "
         f"resize={engine.min_side}/{engine.max_side}"
     )
-    server = DetectionServer(engine, make_serve_config(args))
+    sink = None
+    if obs_dir is not None:
+        # serve_stats / watchdog_stall / slo_violation events land in
+        # metrics.jsonl next to the trace (the perf doctor's events half).
+        from batchai_retinanet_horovod_coco_tpu.obs.events import EventSink
+
+        sink = EventSink(obs_dir, run_config=vars(args))
+        watchdog.default().sink = sink
+    server = DetectionServer(engine, make_serve_config(args), sink=sink)
+    slo_monitor = None
+    status_server = None
     try:
+        # Telemetry/SLO bring-up INSIDE the try: a typo'd --slo-rule or
+        # an already-bound --obs-port must still drain the server and
+        # close the sink on the way out.  Same policy as train.py's
+        # _start_telemetry: either flag starts the monitor (the built-in
+        # stall rule is always included).
+        if (
+            getattr(args, "slo_rule", None)
+            or getattr(args, "obs_port", None) is not None
+        ):
+            from batchai_retinanet_horovod_coco_tpu.obs import slo as slo_lib
+
+            slo_monitor = slo_lib.SloMonitor(
+                server.telemetry,
+                [slo_lib.stall_rule()]
+                + [slo_lib.parse_rule(s) for s in (args.slo_rule or [])],
+                sink=sink,
+                poll_interval=args.slo_poll_s,
+            ).start()
+        if getattr(args, "obs_port", None) is not None:
+            # A second, serve-path-independent scrape port (the offline
+            # --images mode has no HTTP frontend; on --http it lets the
+            # scraper live apart from request traffic).
+            status_server = telemetry.start_http_server(
+                server.telemetry, port=args.obs_port, host=args.host
+            )
+            print(
+                f"telemetry on http://{status_server.host}:"
+                f"{status_server.port} (/metrics /healthz /statusz)"
+            )
         if args.images is not None:
             names = sorted(
                 n for n in os.listdir(args.images)
@@ -526,7 +661,8 @@ def main(argv: list[str] | None = None) -> dict:
             httpd = serve_http(server, args.host, args.http)
             print(
                 f"serving on http://{httpd.server_address[0]}:"
-                f"{httpd.server_address[1]} (POST /detect, GET /stats)"
+                f"{httpd.server_address[1]} (POST /detect; GET /stats "
+                "/metrics /healthz)"
             )
             try:
                 httpd.serve_forever()
@@ -539,7 +675,13 @@ def main(argv: list[str] | None = None) -> dict:
         print(json.dumps({"serve_stats": snap}))
         return snap
     finally:
+        if slo_monitor is not None:
+            slo_monitor.stop()
+        if status_server is not None:
+            status_server.close()
         server.close()
+        if sink is not None:
+            sink.close()
         if obs_dir is not None:
             from batchai_retinanet_horovod_coco_tpu import obs
 

@@ -36,7 +36,7 @@ from batchai_retinanet_horovod_coco_tpu.train.step import (
     make_train_step,
     make_train_step_spatial,
 )
-from batchai_retinanet_horovod_coco_tpu.obs import trace, watchdog
+from batchai_retinanet_horovod_coco_tpu.obs import telemetry, trace, watchdog
 from batchai_retinanet_horovod_coco_tpu.obs.events import device_memory_stats
 from batchai_retinanet_horovod_coco_tpu.obs.trace import monotonic_s
 from batchai_retinanet_horovod_coco_tpu.utils.checkpoint import CheckpointManager
@@ -558,6 +558,11 @@ def run_training(
                             batch=int(images_shape[0]),
                         )
                 loop_hb.beat()
+                # Live-telemetry record site (one bool check while off):
+                # the status server's train_compiles_total/last_compile.
+                telemetry.record_compile(
+                    f"{hw[0]}x{hw[1]}", monotonic_s() - t_compile
+                )
                 # Duck-typed: tests pass bare .log-only logger fakes.
                 log_event = getattr(logger, "event", None)
                 if log_event is not None:
@@ -633,6 +638,15 @@ def run_training(
                     if scale is not None:
                         scalars["lr"] *= scale  # data-driven ReduceLROnPlateau
                 logger.log(step, scalars)
+                # Live-telemetry record site (one bool check while off):
+                # step rate / step time / data-wait fraction for the
+                # --obs-port status server and the SLO monitor's rules.
+                telemetry.record_train_window(
+                    step=step,
+                    images_per_s=scalars["images_per_sec"],
+                    step_time_ms=scalars["step_time_ms"],
+                    data_wait_ms=scalars["data_wait_ms"],
+                )
                 if trace.enabled():
                     # Device HBM occupancy as Chrome counter tracks, once
                     # per log window (memory_stats() is a host call; CPU

@@ -104,6 +104,31 @@ def add_obs_flags(parser) -> None:
                              "— obs/watchdog.py).  Only takes effect "
                              "with --obs-trace/--obs-dir (the subsystem "
                              "is otherwise fully disabled)")
+    # Live telemetry + SLO surface (ISSUE 9, obs/telemetry.py + obs/slo.py)
+    parser.add_argument("--obs-port", type=int, default=None, metavar="PORT",
+                        help="start a drain-safe stdlib HTTP status "
+                             "server on this port (0 = ephemeral, "
+                             "printed at startup) exposing the live "
+                             "telemetry registry during the run: GET "
+                             "/metrics (Prometheus text exposition), "
+                             "/healthz (watchdog-backed liveness — 503 "
+                             "names the stalled component), /statusz "
+                             "(JSON snapshot).  Read-only; daemon "
+                             "threads — it can never wedge a pod exit")
+    parser.add_argument("--slo-rule", action="append", default=None,
+                        metavar="METRIC{>,<}THR[@FOR_S]",
+                        help="declarative SLO over a telemetry snapshot "
+                             "metric, evaluated by a monitor thread; a "
+                             "sustained breach emits exactly ONE "
+                             "structured slo_violation event (JSONL + "
+                             "trace instant + PERF_REPORT violations "
+                             "section).  THR 'x1.5' means regression vs "
+                             "a rolling-median baseline.  Examples: "
+                             "'serve_request_latency_ms.p99>250@30', "
+                             "'train_step_time_ms>x1.5@60'.  Repeatable; "
+                             "a watchdog-stall rule is always included")
+    parser.add_argument("--slo-poll-s", type=float, default=5.0,
+                        help="SLO monitor poll interval (seconds)")
 
 
 def add_serve_flags(parser) -> None:

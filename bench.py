@@ -1083,6 +1083,85 @@ def _serve_overload(engine, hw, batch_size, img) -> dict:
     }
 
 
+def _scrape_telemetry(server) -> dict:
+    """Scrape the live-telemetry plane ONCE per measurement window
+    (ISSUE 9 satellite): mount the real HTTP frontend over the just-
+    measured server, GET /metrics + /healthz, and cross-check the
+    registry-derived p99/shed/completed numbers against the server's own
+    snapshot.  The two sources read the SAME LatencyStats window through
+    different code paths (Prometheus encode → text → parse vs direct
+    snapshot), so any disagreement is a real exposition bug —
+    ``consistent`` is recorded in the bench line and announced, never
+    silently dropped."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from batchai_retinanet_horovod_coco_tpu.obs import telemetry, watchdog
+    from batchai_retinanet_horovod_coco_tpu.serve import serve_http
+
+    httpd = serve_http(server, port=0)
+    hb = watchdog.register("bench-telemetry-scrape")
+    thread = threading.Thread(
+        # Stdlib target: crashes surface as the scrape's urlopen failure.
+        target=httpd.serve_forever, kwargs={"poll_interval": 0.05},
+        daemon=True, name="bench-telemetry-scrape",
+    )
+    thread.start()
+    try:
+        host, port = httpd.server_address[:2]
+        with urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=30
+        ) as r:
+            text = r.read().decode()
+        try:
+            with urllib.request.urlopen(
+                f"http://{host}:{port}/healthz", timeout=30
+            ) as r:
+                health_code = r.status
+                health = json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:  # 503 = stalled (still data)
+            health_code = e.code
+            health = json.loads(e.read().decode())
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        thread.join(timeout=10)
+        hb.close()
+
+    types, samples = telemetry.parse_exposition(text)
+    snap = server.snapshot()
+    p99 = samples.get('serve_request_latency_ms{quantile="0.99"}')
+    shed = sum(
+        v for k, v in samples.items() if k.startswith("serve_shed_total")
+    )
+    completed = samples.get("serve_requests_completed_total")
+    problems = []
+    if types.get("serve_request_latency_ms") != "summary":
+        problems.append("latency family missing/untyped")
+    if completed != snap["completed"]:
+        problems.append(
+            f"completed {completed} != snapshot {snap['completed']}"
+        )
+    if shed != snap["shed_total"]:
+        problems.append(f"shed {shed} != snapshot {snap['shed_total']}")
+    snap_p99 = snap.get("p99_ms")
+    if (p99 is None) != (snap_p99 is None):
+        problems.append(f"p99 presence mismatch ({p99} vs {snap_p99})")
+    elif p99 is not None and abs(p99 - snap_p99) > max(0.5, 0.01 * snap_p99):
+        problems.append(f"p99 {p99} != snapshot {snap_p99}")
+    if problems:
+        print(f"# telemetry-consistency MISMATCH: {problems}", flush=True)
+    return {
+        "registry_p99_ms": p99,
+        "registry_shed_total": shed,
+        "registry_completed": completed,
+        "healthz_status": health_code,
+        "healthz_ok": health_code == 200 and health.get("status") == "ok",
+        "consistent": not problems,
+    }
+
+
 def run_serve_bucket(
     model, state, batch_size: int, hw: tuple[int, int], measure_steps: int,
     overload: bool,
@@ -1122,6 +1201,10 @@ def run_serve_bucket(
             target=measure_steps * batch_size,
             clients=max(2, 2 * batch_size),
         )
+        # One /metrics scrape per window, against the still-open server
+        # (the closed loop has joined its clients, so the stats are
+        # frozen and the two sources must agree exactly).
+        telem = _scrape_telemetry(server)
     finally:
         server.close(drain=False)
     out = {
@@ -1129,6 +1212,7 @@ def run_serve_bucket(
         "detect_ceiling_imgs_per_sec": round(ceiling, 3),
         "vs_ceiling": round(closed["imgs_per_sec"] / max(ceiling, 1e-9), 3),
         **closed,
+        "telemetry": telem,
     }
     if overload:
         with obs_trace.span("serve_overload", bucket=f"{hw[0]}x{hw[1]}"):

@@ -1,0 +1,241 @@
+#!/usr/bin/env python
+"""telemetry-smoke: CPU serve smoke → scrape + schema-check (ISSUE 9).
+
+The CI leg of the live telemetry plane (``make telemetry-smoke``, part of
+``check-static``): bring up a real ``DetectionServer`` + HTTP frontend
+over a stub engine (no device work — the serve machinery, queues,
+watchdog heartbeats, and telemetry registry are all real), drive real
+traffic INCLUDING sheds, then assert the acceptance contract:
+
+- ``GET /metrics`` is valid Prometheus text exposition carrying the
+  request-latency summary (quantile series), per-reason shed counters,
+  and queue-depth gauges;
+- ``GET /healthz`` returns 200 while live, flips to 503 NAMING the
+  stalled component under an injected watchdog stall, and recovers;
+- the registry-derived completed/shed/p99 numbers agree with the
+  server's own ``/stats`` snapshot (the bench consistency check's
+  logic, run here without a chip).
+
+Exit 0 on success; any failed check prints one ``telemetry-smoke
+FAIL:`` line and exits 1.  Stdout ends with one machine-readable JSON
+summary line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # direct `python scripts/telemetry_smoke.py` runs
+    sys.path.insert(0, _REPO)
+
+from batchai_retinanet_horovod_coco_tpu.obs import telemetry, watchdog  # noqa: E402
+from batchai_retinanet_horovod_coco_tpu.serve import (  # noqa: E402
+    DetectionServer,
+    RequestRejected,
+    ServeConfig,
+    serve_http,
+)
+from batchai_retinanet_horovod_coco_tpu.serve.engine import (  # noqa: E402
+    IdentityLabelMap,
+)
+
+
+class _Det:
+    def __init__(self, boxes, scores, labels, valid):
+        self.boxes, self.scores, self.labels = boxes, scores, labels
+        self.valid = valid
+
+
+class StubEngine:
+    """One fixed detection per row; a small dispatch delay so an
+    open-loop flood overwhelms the tiny queues and SHEDS (the smoke must
+    see nonzero shed counters, not just latency)."""
+
+    min_side = 64
+    max_side = 64
+    buckets = ((64, 64),)
+    label_to_cat_id = IdentityLabelMap()
+
+    def __init__(self, delay_s: float = 0.02):
+        self.delay_s = delay_s
+
+    def batch_sizes(self, hw):
+        return [4]
+
+    def max_batch(self, hw):
+        return 4
+
+    def batch_size_for(self, hw, n):
+        return 4
+
+    def warmup(self):
+        pass
+
+    def dispatch(self, hw, images):
+        time.sleep(self.delay_s)
+        b = images.shape[0]
+        boxes = np.tile(
+            np.array([[[1.0, 2.0, 10.0, 20.0]]], np.float32), (b, 1, 1)
+        )
+        return _Det(
+            boxes,
+            np.full((b, 1), 0.5, np.float32),
+            np.zeros((b, 1), np.int32),
+            np.ones((b, 1), bool),
+        )
+
+    def fetch(self, det):
+        return det
+
+
+def _get(url: str) -> tuple[int, bytes]:
+    try:
+        with urllib.request.urlopen(url, timeout=30) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:  # 503 is data here, not an error
+        return e.code, e.read()
+
+
+def main() -> int:
+    failures: list[str] = []
+
+    def check(ok: bool, what: str) -> None:
+        if not ok:
+            failures.append(what)
+            print(f"telemetry-smoke FAIL: {what}", flush=True)
+
+    img = np.zeros((64, 64, 3), np.uint8)
+    server = DetectionServer(
+        StubEngine(),
+        ServeConfig(
+            max_delay_ms=5.0, admission_queue=2, bucket_queue=2,
+            preprocess_workers=1,
+        ),
+    )
+    httpd = serve_http(server, port=0)
+    hb_scrape = watchdog.register("telemetry-smoke-http")
+    thread = threading.Thread(
+        # Stdlib target: a crash surfaces as the scrape's urlopen failure.
+        target=httpd.serve_forever, kwargs={"poll_interval": 0.05},
+        daemon=True, name="telemetry-smoke-http",
+    )
+    thread.start()
+    host, port = httpd.server_address[:2]
+    base = f"http://{host}:{port}"
+    try:
+        # Real traffic: closed-loop completions (one in flight — the
+        # queues are sized 2 precisely so the burst below sheds), then an
+        # open-loop burst against those tiny bounds.
+        for _ in range(3):
+            server.submit(img).result(timeout=30)
+        futures = []
+        shed = 0
+        for _ in range(64):
+            try:
+                futures.append(server.submit(img))
+            except RequestRejected:
+                shed += 1
+        for f in futures:
+            try:
+                f.result(timeout=60)
+            except RequestRejected:
+                pass
+        check(shed > 0, "open-loop burst produced no sheds")
+
+        # /metrics schema.
+        code, body = _get(f"{base}/metrics")
+        check(code == 200, f"/metrics returned {code}")
+        types, samples = telemetry.parse_exposition(body.decode())
+        check(
+            types.get("serve_request_latency_ms") == "summary"
+            and 'serve_request_latency_ms{quantile="0.99"}' in samples
+            and samples.get("serve_request_latency_ms_count", 0) > 0,
+            "request-latency summary missing from /metrics",
+        )
+        check(
+            types.get("serve_shed_total") == "counter"
+            and sum(
+                v for k, v in samples.items()
+                if k.startswith("serve_shed_total")
+            ) > 0,
+            "shed counters missing/zero in /metrics",
+        )
+        check(
+            types.get("serve_queue_depth") == "gauge"
+            and any(k.startswith("serve_queue_depth{") for k in samples),
+            "queue-depth gauges missing from /metrics",
+        )
+        check(
+            types.get("watchdog_beat_age_seconds") == "gauge",
+            "watchdog beat-age gauges missing from /metrics",
+        )
+
+        # Registry vs snapshot consistency (same window, two paths).
+        snap = server.snapshot()
+        check(
+            samples.get("serve_requests_completed_total")
+            == snap["completed"],
+            "completed_total disagrees with /stats snapshot",
+        )
+        check(
+            sum(
+                v for k, v in samples.items()
+                if k.startswith("serve_shed_total")
+            )
+            == snap["shed_total"],
+            "shed_total disagrees with /stats snapshot",
+        )
+
+        # /healthz: live, stalled (named), recovered.
+        code, body = _get(f"{base}/healthz")
+        payload = json.loads(body.decode())
+        check(
+            code == 200 and payload["status"] == "ok",
+            f"/healthz not live: {code} {payload}",
+        )
+        check(
+            "inflight" in payload.get("load", {})
+            and "p99_ms" in payload.get("load", {}),
+            "/healthz lacks per-replica load fields",
+        )
+        wedge = watchdog.register("smoke-wedged", stall_after=0.01)
+        time.sleep(0.05)
+        code, body = _get(f"{base}/healthz")
+        payload = json.loads(body.decode())
+        check(
+            code == 503 and payload.get("component") == "smoke-wedged",
+            f"stalled /healthz wrong: {code} {payload}",
+        )
+        wedge.close()
+        code, _body = _get(f"{base}/healthz")
+        check(code == 200, f"/healthz did not recover: {code}")
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+        thread.join(timeout=10)
+        hb_scrape.close()
+        server.close(drain=False)
+
+    print(
+        json.dumps(
+            {
+                "telemetry_smoke": "ok" if not failures else "fail",
+                "failures": failures,
+            }
+        ),
+        flush=True,
+    )
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

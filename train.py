@@ -416,6 +416,38 @@ def main(argv=None) -> dict[str, float]:
                 )
 
 
+def _start_telemetry(args, logger):
+    """Live-telemetry bring-up (ISSUE 9): the --obs-port status server
+    (GET /metrics /healthz /statusz over the process-default registry the
+    train loop feeds) and the SLO monitor (--slo-rule + the built-in
+    watchdog-stall rule), violations sinking into the run's metrics
+    JSONL.  Returns (status_server | None, slo_monitor | None); the
+    caller owns the bounded, idempotent teardown — both are daemon-
+    threaded and can never wedge a pod exit."""
+    port = getattr(args, "obs_port", None)
+    rule_specs = getattr(args, "slo_rule", None) or []
+    if port is None and not rule_specs:
+        return None, None
+    from batchai_retinanet_horovod_coco_tpu.obs import slo, telemetry
+
+    telemetry.enable()  # arm the loop's push record sites (one bool)
+    server = None
+    if port is not None:
+        server = telemetry.start_http_server(telemetry.default(), port=port)
+        print(
+            f"obs: telemetry on http://{server.host}:{server.port} "
+            "(/metrics /healthz /statusz)",
+            flush=True,
+        )
+    monitor = slo.SloMonitor(
+        telemetry.default(),
+        [slo.stall_rule()] + [slo.parse_rule(s) for s in rule_specs],
+        sink=logger,
+        poll_interval=getattr(args, "slo_poll_s", 5.0),
+    ).start()
+    return server, monitor
+
+
 def _run(args) -> dict[str, float]:
     if args.platform != "auto":
         # Must land before any backend initialization.  The CPU path also
@@ -814,70 +846,86 @@ def _run(args) -> dict[str, float]:
 
         watchdog.default().sink = logger
 
-    if args.eval_only:
-        if args.snapshot_path:
-            from batchai_retinanet_horovod_coco_tpu.utils.checkpoint import (
-                CheckpointManager,
-            )
-
-            state = CheckpointManager(args.snapshot_path).restore(state)
-        if mesh is not None and shard_count == 1:
-            # Multi-host skips this: restored arrays are committed to local
-            # devices (cross-host device_put is unsupported on some
-            # backends) and the sharded eval_fn pulls state to host anyway.
-            from batchai_retinanet_horovod_coco_tpu.parallel.mesh import (
-                replicated_sharding,
-            )
-
-            state = jax.device_put(state, replicated_sharding(mesh))
-        metrics = eval_fn(state)
-        logger.log(int(state.step), metrics, prefix="eval")
-        return metrics
-
-    train_batches = build_pipeline(
-        train_ds,
-        PipelineConfig(
-            batch_size=local_batch, shuffle=True, transform=train_transform,
-            shard_index=shard_index, shard_count=shard_count, **pipe_common,
-        ),
-        train=True,
-    )
+    # Live telemetry around the run (status server + SLO monitor); the
+    # teardown is bounded and idempotent, so a traced run's obs finalize
+    # (main()'s finally) always runs after a clean telemetry drain.
+    telem_server, slo_monitor = _start_telemetry(args, logger)
     try:
-        state = run_training(
-        model,
-        state,
-        train_batches,
-        num_classes,
-        LoopConfig(
-            total_steps=args.steps,
-            log_every=args.log_every,
-            checkpoint_every=args.checkpoint_every if args.snapshot_path else 0,
-            eval_every=args.eval_every,
-            checkpoint_dir=args.snapshot_path,
-            resume=not args.no_resume,
-            profile_dir=args.profile_dir,
-            device_prefetch=args.device_prefetch,
-            async_eval=args.async_eval,
-        ),
-        mesh=mesh,
-        schedule=schedule,
-        anchor_config=anchor_config,
-        shard_weight_update=shard_update,
-        quantized_allreduce=quantized,
-        allow_data_axis_divergence=args.allow_data_axis_divergence,
-        eval_fn=eval_fn
-        if (args.eval_every or args.dataset_type in ("coco", "pascal")
-            or (args.dataset_type == "csv" and val_ds is not None))
-        else None,
-        logger=logger,
+        if args.eval_only:
+            if args.snapshot_path:
+                from batchai_retinanet_horovod_coco_tpu.utils.checkpoint import (
+                    CheckpointManager,
+                )
+
+                state = CheckpointManager(args.snapshot_path).restore(state)
+            if mesh is not None and shard_count == 1:
+                # Multi-host skips this: restored arrays are committed to
+                # local devices (cross-host device_put is unsupported on
+                # some backends) and the sharded eval_fn pulls state to
+                # host anyway.
+                from batchai_retinanet_horovod_coco_tpu.parallel.mesh import (
+                    replicated_sharding,
+                )
+
+                state = jax.device_put(state, replicated_sharding(mesh))
+            metrics = eval_fn(state)
+            logger.log(int(state.step), metrics, prefix="eval")
+            return metrics
+
+        train_batches = build_pipeline(
+            train_ds,
+            PipelineConfig(
+                batch_size=local_batch, shuffle=True,
+                transform=train_transform,
+                shard_index=shard_index, shard_count=shard_count,
+                **pipe_common,
+            ),
+            train=True,
         )
+        try:
+            state = run_training(
+                model,
+                state,
+                train_batches,
+                num_classes,
+                LoopConfig(
+                    total_steps=args.steps,
+                    log_every=args.log_every,
+                    checkpoint_every=(
+                        args.checkpoint_every if args.snapshot_path else 0
+                    ),
+                    eval_every=args.eval_every,
+                    checkpoint_dir=args.snapshot_path,
+                    resume=not args.no_resume,
+                    profile_dir=args.profile_dir,
+                    device_prefetch=args.device_prefetch,
+                    async_eval=args.async_eval,
+                ),
+                mesh=mesh,
+                schedule=schedule,
+                anchor_config=anchor_config,
+                shard_weight_update=shard_update,
+                quantized_allreduce=quantized,
+                allow_data_axis_divergence=args.allow_data_axis_divergence,
+                eval_fn=eval_fn
+                if (args.eval_every or args.dataset_type in ("coco", "pascal")
+                    or (args.dataset_type == "csv" and val_ds is not None))
+                else None,
+                logger=logger,
+            )
+        finally:
+            # Deterministic pipeline teardown (previously left to the GC
+            # finalizer): decode workers/threads are reaped HERE, so shm
+            # workers export their trace files BEFORE main()'s obs
+            # finalize merges — a GC-time close would orphan them from
+            # trace.json.
+            train_batches.close()
+        return {"final_step": float(int(state.step))}
     finally:
-        # Deterministic pipeline teardown (previously left to the GC
-        # finalizer): decode workers/threads are reaped HERE, so shm
-        # workers export their trace files BEFORE main()'s obs finalize
-        # merges — a GC-time close would orphan them from trace.json.
-        train_batches.close()
-    return {"final_step": float(int(state.step))}
+        if slo_monitor is not None:
+            slo_monitor.stop()
+        if telem_server is not None:
+            telem_server.close()
 
 
 if __name__ == "__main__":

@@ -22,7 +22,8 @@ CLUSTER = python -m batchai_retinanet_horovod_coco_tpu.launch.cluster
 	bench-check bench-pipeline pipebench pipebench-check evalbench \
 	evalbench-check servebench servebench-check canaries \
 	convergence-full lint lint-obs check-static tune-smoke tunebench \
-	tunebench-check perf-report perf-report-check telemetry-smoke
+	tunebench-check perf-report perf-report-check telemetry-smoke \
+	numerics-smoke
 
 create:
 	$(CLUSTER) create --name $(NAME) --zone $(ZONE) --accelerator $(ACCEL) $(DRYFLAG)
@@ -65,7 +66,7 @@ bench:
 # regression).  Every mode probes the TPU first and classifies a tunnel
 # outage as ONE structured JSON line + exit 75, never an rc-1 traceback.
 bench-check:
-	BENCH_SWEEP=0 BENCH_CHECK=1 python bench.py
+	BENCH_SWEEP=0 BENCH_NUMERICS=0 BENCH_CHECK=1 python bench.py
 	BENCH_SWEEP=0 EVALBENCH_E2E=0 BENCH_CHECK=1 python bench.py --mode eval
 	BENCH_SWEEP=0 SERVEBENCH_OVERLOAD=0 BENCH_CHECK=1 python bench.py --mode serve
 	$(MAKE) perf-report-check
@@ -127,10 +128,20 @@ lint:
 telemetry-smoke:
 	JAX_PLATFORMS=cpu python scripts/telemetry_smoke.py
 
+# Numerics flight recorder smoke (ISSUE 10): CPU train smoke with an
+# injected mid-run NaN → asserts, without any rerun, that ONE
+# NUMERICS_DUMP.json lands naming the first non-finite layer, the
+# built-in nonfinite SLO rule fires EXACTLY ONCE (metrics.jsonl + trace
+# timeline), the auto-emitted PERF_REPORT ranks the numerics:divergence
+# verdict #1, and the numerics-off step leaks no summary keys.  No chip,
+# no dataset — CI-safe; aggregated into check-static.
+numerics-smoke:
+	JAX_PLATFORMS=cpu python scripts/numerics_smoke.py
+
 # bench-check-style aggregate for everything static: one target CI can run
 # without touching a chip or a dataset.
-check-static: lint telemetry-smoke
-	@echo "check-static: lint engine + watchdog audit + HLO collective audit + telemetry smoke all green"
+check-static: lint telemetry-smoke numerics-smoke
+	@echo "check-static: lint engine + watchdog audit + HLO collective audit + telemetry smoke + numerics smoke all green"
 
 # Static watchdog-coverage audit alone (ISSUE 3; now a shim over the lint
 # engine's watchdog-coverage rule — same CLI, same exit codes).  Also runs

@@ -42,15 +42,17 @@ from batchai_retinanet_horovod_coco_tpu.obs import watchdog
 from batchai_retinanet_horovod_coco_tpu.obs import events
 
 __all__ = [
-    "trace", "watchdog", "events", "telemetry", "slo", "enable", "finalize",
+    "trace", "watchdog", "events", "telemetry", "slo", "numerics",
+    "enable", "finalize",
 ]
 
 
 def __getattr__(name: str):
-    # Lazy submodule access (``obs.telemetry`` / ``obs.slo``): keeps the
-    # package's import-time surface exactly the PR-3 trio for jax-free
-    # worker processes that only need trace/watchdog/events.
-    if name in ("telemetry", "slo"):
+    # Lazy submodule access (``obs.telemetry`` / ``obs.slo`` /
+    # ``obs.numerics``): keeps the package's import-time surface exactly
+    # the PR-3 trio for jax-free worker processes that only need
+    # trace/watchdog/events (numerics imports jax at module top).
+    if name in ("telemetry", "slo", "numerics"):
         import importlib
 
         return importlib.import_module(f"{__name__}.{name}")

@@ -28,6 +28,13 @@ function from a run's own artifacts to
 - **a ranked top-3 bottleneck verdict** — each entry names the spans to
   stare at in Perfetto and the ``tune/`` problems (``nms``, ``focal``,
   ``matching``, ``batch``) the next optimization PR should search;
+- **a numerics section** (ISSUE 10, schema v3) — the numerics flight
+  recorder's read-back: per-log-window grad-norm/update-ratio/
+  replica-agreement series from the ``numerics`` JSONL records, tripped
+  finite-checks from the ``numerics_trip`` trace/JSONL markers, and the
+  NUMERICS_DUMP.json cross-reference.  Any trip or non-finite count
+  contributes a ``numerics:divergence`` verdict at the absolute head of
+  the ranking — a run computing NaNs has no performance question left;
 - **an SLO violations section** (ISSUE 9, schema v2) — the
   ``slo_violation`` events the live monitor (obs/slo.py) emitted, read
   from BOTH the events JSONL and the trace's instant markers and
@@ -53,6 +60,7 @@ import discipline as the rest of obs/.
 from __future__ import annotations
 
 import json
+import math
 import os
 import sys
 from typing import Any, Iterable
@@ -62,8 +70,10 @@ from batchai_retinanet_horovod_coco_tpu.obs.events import (
     split_runs,
 )
 
+# v3 (ISSUE 10): + the ``numerics`` section (grad/update health, trip
+# markers, NUMERICS_DUMP cross-reference) and its numerics:* verdicts.
 # v2 (ISSUE 9): + the ``violations`` section and its slo:* verdicts.
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 # Peak dense bf16 TFLOP/s per chip by device kind (public spec sheets) —
 # THE table, shared with bench.py's MFU line (one source of truth).
@@ -444,13 +454,25 @@ def _memory_section(counters: dict[str, list[tuple[float, float]]]) -> dict:
     return out
 
 
-def _events_section(events_path: str | None) -> dict:
+def _load_runs(
+    events_path: str | None,
+) -> tuple[list[dict] | None, str | None]:
+    """ONE ``split_runs`` parse of metrics.jsonl, shared by the events,
+    violations and numerics sections (a long run's JSONL is multi-MB —
+    three per-section parses were pure waste).  Returns (runs, error)."""
     if not events_path or not os.path.exists(events_path):
-        return {"available": False}
+        return None, None
     try:
-        runs = split_runs(events_path)
+        return split_runs(events_path), None
     except OSError as e:
-        return {"available": False, "error": repr(e)[:200]}
+        return None, repr(e)[:200]
+
+
+def _events_section(
+    runs: list[dict] | None, error: str | None = None
+) -> dict:
+    if error:
+        return {"available": False, "error": error}
     if not runs:
         return {"available": False}
     run = runs[-1]  # the most recent run in an append-mode file
@@ -534,7 +556,7 @@ def _mfu_section(
 
 
 def _violations_section(
-    events: list[dict], events_path: str | None
+    events: list[dict], runs: list[dict] | None
 ) -> dict:
     """The SLO read-back: ``slo_violation`` trace instants + JSONL events
     aggregated per rule.  The JSONL records are the richer source (they
@@ -546,17 +568,12 @@ def _violations_section(
         dict(e.get("args") or {}) for e in _instants(events, "slo_violation")
     ]
     jsonl_v: list[dict] = []
-    if events_path and os.path.exists(events_path):
-        try:
-            runs = split_runs(events_path)
-        except OSError:
-            runs = []
-        if runs:
-            jsonl_v = [
-                r
-                for r in runs[-1].get("records", [])
-                if r.get("event") == "slo_violation"
-            ]
+    if runs:
+        jsonl_v = [
+            r
+            for r in runs[-1].get("records", [])
+            if r.get("event") == "slo_violation"
+        ]
     rules: dict[str, dict] = {}
     for source in (jsonl_v, trace_v):
         counts: dict[str, int] = {}
@@ -589,6 +606,123 @@ def _violations_section(
         "jsonl_events": len(jsonl_v),
         "rules": {k: rules[k] for k in sorted(rules)},
     }
+
+
+def _series_stats(values: list[float]) -> dict | None:
+    finite = [v for v in values if isinstance(v, (int, float))]
+    if not finite:
+        return None
+    fin = [v for v in finite if math.isfinite(v)]
+    out = {
+        "samples": len(finite),
+        "nonfinite_samples": len(finite) - len(fin),
+        "last": _r(finite[-1]) if math.isfinite(finite[-1]) else None,
+    }
+    if fin:
+        out["max"] = _r(max(fin))
+        out["min"] = _r(min(fin))
+        s = sorted(fin)
+        mid = len(s) // 2
+        out["median"] = _r(
+            s[mid] if len(s) % 2 else (s[mid - 1] + s[mid]) / 2.0
+        )
+    return out
+
+
+def _numerics_section(
+    events: list[dict], runs: list[dict] | None, dump_path: str | None
+) -> dict:
+    """The numerics flight recorder's read-back (ISSUE 10): ``numerics``
+    JSONL records (per-log-window grad/update health), ``numerics_trip``
+    markers from BOTH the trace timeline and the JSONL, and the
+    NUMERICS_DUMP.json the abort path landed (cross-referenced, never
+    re-derived).  ``available`` is False only when no source exists at
+    all — a run with the summary off but a tripped finite-check still
+    gets its trip + dump surfaced."""
+    def safe(v):
+        # NaN/Inf values (a trip's whole point) must not leak bare NaN
+        # tokens into the report JSON — stringify them.
+        if isinstance(v, float) and not math.isfinite(v):
+            return repr(v)
+        return v
+
+    records: list[dict] = []
+    trips_jsonl: list[dict] = []
+    metric_grad_norms: list[float] = []
+    if runs:
+        for r in runs[-1].get("records", []):
+            if r.get("event") == "numerics":
+                records.append(r)
+            elif r.get("event") == "numerics_trip":
+                trips_jsonl.append(r)
+            elif "step" in r and "event" not in r:
+                if isinstance(r.get("train/grad_norm"), (int, float)):
+                    metric_grad_norms.append(r["train/grad_norm"])
+    trips_trace = [
+        dict(e.get("args") or {})
+        for e in _instants(events, "numerics_trip")
+    ]
+    # The richer JSONL trips win; trace markers stand in for a run whose
+    # events half is missing (the violations-section policy).
+    trips = trips_jsonl or trips_trace
+    dump = None
+    if dump_path and os.path.exists(dump_path):
+        try:
+            with open(dump_path) as f:
+                d = json.load(f)
+            tripped = d.get("tripped")
+            dump = {
+                "present": True,
+                "step": d.get("step"),
+                "first_nonfinite": d.get("first_nonfinite"),
+                "tripped": {k: safe(v) for k, v in tripped.items()}
+                if isinstance(tripped, dict)
+                else tripped,
+            }
+        except (OSError, ValueError) as e:
+            dump = {"present": True, "error": repr(e)[:200]}
+    grad_norms = [
+        r["grad_norm"]
+        for r in records
+        if isinstance(r.get("grad_norm"), (int, float))
+    ] or metric_grad_norms
+    nonfinite_total = sum(
+        float(r.get("nonfinite_grads") or 0.0)
+        for r in records
+        if isinstance(r.get("nonfinite_grads"), (int, float))
+    )
+    out: dict[str, Any] = {
+        "available": bool(records or trips or dump or metric_grad_norms),
+        "records": len(records),
+        "grad_norm": _series_stats(grad_norms),
+        "update_ratio": _series_stats(
+            [
+                r["update_ratio"]
+                for r in records
+                if isinstance(r.get("update_ratio"), (int, float))
+            ]
+        ),
+        "replica_agreement": _series_stats(
+            [
+                r["replica_agreement"]
+                for r in records
+                if isinstance(r.get("replica_agreement"), (int, float))
+            ]
+        ),
+        "nonfinite_total": _r(nonfinite_total, 1),
+        "trips": {
+            "count": max(len(trips_jsonl), len(trips_trace)),
+            "trace_markers": len(trips_trace),
+            "jsonl_events": len(trips_jsonl),
+            "first": {
+                k: safe(trips[0].get(k)) for k in ("metric", "step", "value")
+            }
+            if trips
+            else None,
+        },
+        "dump": dump or {"present": False},
+    }
+    return out
 
 
 def _stalls_section(events: list[dict], events_section: dict) -> dict:
@@ -638,6 +772,7 @@ def _bottlenecks(
     spans: dict[str, list[dict]],
     queues: dict,
     violations: dict | None = None,
+    numerics: dict | None = None,
 ) -> list[dict]:
     """Ranked verdicts, scores all expressed as fractions of the main
     window so they are mutually comparable.  Non-empty whenever the trace
@@ -825,7 +960,47 @@ def _bottlenecks(
                 "tune_ops": _slo_tune_ops(info.get("metric")),
             }
         )
-    top = vio_cands + top
+    num_cands: list[dict] = []
+    trips = ((numerics or {}).get("trips") or {}).get("count", 0)
+    nonfinite = (numerics or {}).get("nonfinite_total") or 0
+    if trips or nonfinite:
+        # Numerical divergence outranks EVERYTHING — a run computing NaNs
+        # has no performance question left to answer, so the verdict sits
+        # above even declared-SLO breaches (which include the nonfinite
+        # rule itself; acceptance pins rank 1 on the NaN smoke).
+        first = ((numerics or {}).get("trips") or {}).get("first") or {}
+        dump = (numerics or {}).get("dump") or {}
+        located = (
+            f"; first non-finite: {dump.get('first_nonfinite')}"
+            if dump.get("first_nonfinite")
+            else ""
+        )
+        num_cands.append(
+            {
+                "name": "numerics:divergence",
+                "score": 1.0,
+                "spans": ["numerics_trip"],
+                "evidence": (
+                    f"{int(trips)} tripped finite-check(s), "
+                    f"{nonfinite:g} non-finite gradient element(s)"
+                    + (
+                        f" (tripped metric {first.get('metric')} at step "
+                        f"{first.get('step')})"
+                        if first.get("metric")
+                        else ""
+                    )
+                    + located
+                ),
+                "suggestion": (
+                    "read NUMERICS_DUMP.json (debug.py nans <dump>) for "
+                    "the first non-finite layer/loss term — no "
+                    "--debug-nans rerun needed (RUNBOOK 'Numerics "
+                    "triage')"
+                ),
+                "tune_ops": [],
+            }
+        )
+    top = num_cands + vio_cands + top
     for i, c in enumerate(top):
         c["rank"] = i + 1
     return top
@@ -840,8 +1015,10 @@ def analyze_events(
     events: list[dict],
     events_path: str | None = None,
     trace_health: dict | None = None,
+    dump_path: str | None = None,
 ) -> dict:
-    """Chrome events (+ optional events JSONL path) → the report dict."""
+    """Chrome events (+ optional events JSONL path + optional
+    NUMERICS_DUMP.json path) → the report dict."""
     spans = _spans_by_name(events)
     counters = _counters_by_name(events)
     steps = _steps_section(spans)
@@ -854,8 +1031,10 @@ def analyze_events(
         ),
     }
     queues = _queue_section(counters, spans.get("data_wait") or [])
-    events_section = _events_section(events_path)
-    violations = _violations_section(events, events_path)
+    runs, runs_error = _load_runs(events_path)
+    events_section = _events_section(runs, runs_error)
+    violations = _violations_section(events, runs)
+    numerics = _numerics_section(events, runs, dump_path)
     run_meta = _instants(events, "run_meta")
     meta_args = (run_meta[-1].get("args") or {}) if run_meta else {}
     device_kind = meta_args.get("device_kind") or (
@@ -879,10 +1058,11 @@ def analyze_events(
         "mfu": _mfu_section(events, steps, device_kind),
         "stalls": _stalls_section(events, events_section),
         "violations": violations,
+        "numerics": numerics,
         "events": events_section,
         "span_stats": _span_stats(spans),
         "bottlenecks": _bottlenecks(
-            steps, pipeline, spans, queues, violations
+            steps, pipeline, spans, queues, violations, numerics
         ),
         "health": dict(trace_health or {}),
     }
@@ -893,6 +1073,7 @@ def analyze_dir(
     obs_dir: str,
     trace_name: str = "trace.json",
     events_name: str | None = "metrics.jsonl",
+    dump_name: str | None = "NUMERICS_DUMP.json",
 ) -> dict:
     """The offline entrypoint: an obs dir (as left by a --obs-trace run)
     → the report dict.  The trace is required; the events JSONL is
@@ -900,18 +1081,24 @@ def analyze_dir(
     to None).  ``events_name=None`` skips the JSONL entirely — the bench
     emitters use this: bench never writes events, and a shared obs dir
     may hold a PREVIOUS train run's metrics.jsonl whose header/compile
-    records must not be attributed to this trace."""
+    records must not be attributed to this trace.  A NUMERICS_DUMP.json
+    next to the trace (the loop's abort-path artifact) is
+    cross-referenced into the numerics section when present."""
     trace_path = os.path.join(obs_dir, trace_name)
     events, health = load_trace(trace_path)
     events_path = (
         os.path.join(obs_dir, events_name) if events_name else None
     )
+    dump_path = os.path.join(obs_dir, dump_name) if dump_name else None
     report = analyze_events(
         events,
         events_path=events_path
         if events_path and os.path.exists(events_path)
         else None,
         trace_health=health,
+        dump_path=dump_path
+        if dump_path and os.path.exists(dump_path)
+        else None,
     )
     report["source"]["trace"] = trace_name
     return report
@@ -983,6 +1170,7 @@ def validate_report(report: Any) -> list[str]:
         "mfu",
         "stalls",
         "violations",
+        "numerics",
         "events",
         "span_stats",
         "bottlenecks",
@@ -995,6 +1183,13 @@ def validate_report(report: Any) -> list[str]:
         violations.get("rules"), dict
     ):
         problems.append("violations section malformed (needs a rules map)")
+    numerics = report.get("numerics")
+    if not isinstance(numerics, dict) or "available" not in numerics or not (
+        isinstance(numerics.get("trips"), dict)
+    ):
+        problems.append(
+            "numerics section malformed (needs available + trips map)"
+        )
     steps = report.get("steps")
     if isinstance(steps, dict):
         d = steps.get("decomposition")

@@ -1,0 +1,457 @@
+"""Numerics flight recorder: in-step gradient/update health + NaN provenance.
+
+The obs stack explains *where time went* (trace/analyze) and *how loaded
+the process is* (telemetry/slo) — this module is the third axis: *is
+training numerically healthy, right now, and if not, where did it break*.
+Three coordinated pieces (ISSUE 10):
+
+- **In-step summary** (jit-pure, fused into the compiled train step):
+  global + per-layer-group gradient norms, the update/param-norm ratio,
+  and a non-finite element count — computed from arrays the step already
+  holds, ~2 extra global reduces when enabled and NOTHING when disabled
+  (the gate is a trace-time Python bool: the disabled step's HLO is
+  byte-identical to the pre-ISSUE-10 step).  The pre-clip global grad
+  norm is computed ONCE and shared with the optax clip chain
+  (train/optim.py ``clip_by_global_norm_precomputed`` consumes it via
+  extra args) instead of being recomputed inside the clip.
+- **Provenance pass** (host-side, failure path only): when the loop's
+  finite-check trips, ``provenance`` localizes the first non-finite
+  loss term / parameter / layer activation (one forward with flax
+  ``capture_intermediates`` — no ``--debug-nans`` rerun) and
+  ``write_dump`` lands ONE ``NUMERICS_DUMP.json`` (step, batch source
+  ids, rng seed, per-layer stats) before the abort raises.
+  ``debug.py nans`` is a thin driver over ``load_dump``/``format_dump``
+  — the tree-walk lives here and only here.
+- **Cross-replica agreement probe** (``replica_agreement``, called
+  inside the sharded step): each replica's LOCAL pre-allreduce gradient
+  norm vs the axis min/max — silent desync (one replica stepping on
+  corrupted params) shows up as a collapsing agreement ratio long
+  before the loss goes visibly wrong on the multichip/ZeRO path.
+
+House rules: the in-step helpers are jit-pure by construction (pure
+``jnp``, no clocks/prints/IO — the lint engine's jit-purity rule checks
+them for free); the host helpers run only on the failure path or under
+an explicit CLI, so their cost is irrelevant.  This module imports jax
+and must stay OUT of jax-free processes — ``obs/__init__`` exposes it
+lazily, like ``obs.telemetry``/``obs.slo``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+# ---------------------------------------------------------------------------
+# Config + the metric-key vocabulary
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class NumericsConfig:
+    """Compile-time gate for the in-step summary (train/step.py).
+
+    ``enabled=False`` adds NOTHING to the compiled step (the gate is a
+    Python bool at trace time); the loop's record sites then cost one
+    bool check each, the telemetry-discipline contract."""
+
+    enabled: bool = False
+    per_group: bool = True  # per-top-level-param-group gradient norms
+    replica_agreement: bool = True  # cross-replica probe (mesh steps only)
+
+
+#: Metric keys the summary contributes (the loop/telemetry/analyzer read
+#: these names — one vocabulary, defined here).
+GRAD_NORM = "grad_norm"
+UPDATE_RATIO = "update_ratio"
+NONFINITE = "nonfinite_grads"
+REPLICA_AGREEMENT = "replica_agreement"
+GROUP_PREFIX = "gnorm/"
+
+#: Scalars whose non-finiteness the provenance pass attributes first, in
+#: root-cause order (a NaN cls_loss names the classification path even
+#: though the total loss is NaN too).
+_SCALAR_ORDER = (
+    "cls_loss",
+    "box_loss",
+    "loss",
+    GRAD_NORM,
+    "param_norm",
+    UPDATE_RATIO,
+)
+
+_EPS = 1e-16
+
+
+def numerics_metric_keys(scalars: Mapping[str, Any]) -> list[str]:
+    """The summary's keys present in a metrics mapping (loop record site)."""
+    fixed = {GRAD_NORM, UPDATE_RATIO, NONFINITE, REPLICA_AGREEMENT}
+    return sorted(
+        k for k in scalars if k in fixed or k.startswith(GROUP_PREFIX)
+    )
+
+
+# ---------------------------------------------------------------------------
+# jit-pure in-step helpers (train/step.py)
+# ---------------------------------------------------------------------------
+
+
+def nonfinite_count(tree: Any) -> jnp.ndarray:
+    """Total non-finite elements across a pytree (one fused reduce)."""
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return jnp.zeros((), jnp.float32)
+    return sum(
+        jnp.sum(~jnp.isfinite(leaf)).astype(jnp.float32) for leaf in leaves
+    )
+
+
+def group_norms(tree: Mapping[str, Any]) -> dict[str, jnp.ndarray]:
+    """L2 norm per top-level subtree (``backbone``/``fpn``/``cls_head``/
+    ``box_head`` for the RetinaNet family) — the per-layer-group view
+    that tells a diverging head from a diverging backbone."""
+    out: dict[str, jnp.ndarray] = {}
+    for key in tree:
+        sq = sum(
+            jnp.sum(jnp.square(leaf)) for leaf in jax.tree.leaves(tree[key])
+        )
+        out[str(key)] = jnp.sqrt(sq)
+    return out
+
+
+def update_norm(params: Any, new_params: Any) -> jnp.ndarray:
+    """Global L2 norm of the applied update (new − old), one reduce."""
+    sq = sum(
+        jnp.sum(jnp.square(n - o))
+        for n, o in zip(jax.tree.leaves(new_params), jax.tree.leaves(params))
+    )
+    return jnp.sqrt(sq)
+
+
+def update_ratio(
+    params: Any, new_params: Any, param_norm: jnp.ndarray
+) -> jnp.ndarray:
+    """||new − old|| / ||new|| — the classic step-health ratio (a healthy
+    run sits around 1e-3; ~1 means the update is rewriting the model,
+    ~0 under a finite loss means the optimizer has stalled)."""
+    return update_norm(params, new_params) / jnp.maximum(param_norm, _EPS)
+
+
+def step_summary(
+    grads: Any,
+    params: Any,
+    new_params: Any,
+    param_norm: jnp.ndarray,
+    config: NumericsConfig,
+) -> dict[str, jnp.ndarray]:
+    """The fused per-step numerics summary (call INSIDE the train step,
+    after the gradient reduce and the update, on REPLICATED trees —
+    the ZeRO step hand-assembles the same keys from its shards).
+    Returns metric entries to merge into the step's metrics dict; ~2
+    extra global reduces (non-finite count + update norm) plus one small
+    reduce per group."""
+    out: dict[str, jnp.ndarray] = {
+        NONFINITE: nonfinite_count(grads),
+        UPDATE_RATIO: update_ratio(params, new_params, param_norm),
+    }
+    if config.per_group and isinstance(grads, Mapping):
+        for key, norm in group_norms(grads).items():
+            out[f"{GROUP_PREFIX}{key}"] = norm
+    return out
+
+
+def replica_agreement(
+    local_norm: jnp.ndarray, axis_name: str
+) -> jnp.ndarray:
+    """min/max ratio of the per-replica LOCAL gradient norms over a mesh
+    axis (call inside ``shard_map``).  ~1.0 = replicas agree (healthy
+    data variation keeps it well above 0); a collapsing ratio is the
+    silent-desync signature — one replica's gradients have diverged from
+    the rest without any collective erroring."""
+    mx = lax.pmax(local_norm, axis_name)
+    mn = lax.pmin(local_norm, axis_name)
+    return jnp.where(mx > 0, mn / jnp.maximum(mx, _EPS), 1.0)
+
+
+# ---------------------------------------------------------------------------
+# Host-side finite checks (train/loop.py — cadence + pre-save share these)
+# ---------------------------------------------------------------------------
+
+
+def first_nonfinite_scalar(
+    scalars: Mapping[str, Any]
+) -> tuple[str, float] | None:
+    """THE finite-check helper: first non-finite entry of a scalar map in
+    root-cause order (``_SCALAR_ORDER`` first, then alphabetical), or
+    None when everything is finite.  Both the loop's cadence check and
+    its pre-save poisoned-state gate go through here."""
+    order = [k for k in _SCALAR_ORDER if k in scalars] + sorted(
+        k for k in scalars if k not in _SCALAR_ORDER
+    )
+    for name in order:
+        try:
+            value = float(np.asarray(scalars[name]))
+        except (TypeError, ValueError):
+            continue
+        if not np.isfinite(value):
+            return name, value
+    return None
+
+
+def tree_all_finite(tree: Any) -> bool:
+    """Host-side: every leaf of a pytree finite (device_get as needed)."""
+    for leaf in jax.tree.leaves(tree):
+        if not bool(np.all(np.isfinite(np.asarray(jax.device_get(leaf))))):
+            return False
+    return True
+
+
+# ---------------------------------------------------------------------------
+# Provenance pass (failure path / debug CLI)
+# ---------------------------------------------------------------------------
+
+# Coarse topological rank for the RetinaNet family: the first non-finite
+# layer is the EARLIEST one in forward order, and module paths don't carry
+# execution order — this heuristic does (backbone stem → stages → fpn →
+# heads → root outputs).
+_TOP_RANK = {"backbone": 0, "fpn": 1, "cls_head": 2, "box_head": 2}
+_STAGE_RE = re.compile(r"stage(\d+)")
+
+
+def _path_str(path) -> str:
+    return jax.tree_util.keystr(path)
+
+
+def _layer_sort_key(path: str) -> tuple:
+    names = re.findall(r"'([^']+)'", path)
+    top = names[0] if names else ""
+    rank = _TOP_RANK.get(top, 3)
+    stage = 99
+    if rank == 0:
+        m = _STAGE_RE.search(path)
+        if "stem" in path:
+            stage = 0
+        elif m:
+            stage = int(m.group(1))
+    return (rank, stage, path)
+
+
+def _leaf_stats(value: Any) -> dict[str, Any]:
+    arr = np.asarray(jax.device_get(value), dtype=np.float64)
+    finite = np.isfinite(arr)
+    n_bad = int(arr.size - int(finite.sum()))
+    stats: dict[str, Any] = {"size": int(arr.size), "nonfinite": n_bad}
+    if arr.size:
+        stats["nan"] = int(np.isnan(arr).sum())
+        stats["inf"] = n_bad - stats["nan"]
+        if finite.any():
+            fin = arr[finite]
+            stats["min"] = float(fin.min())
+            stats["max"] = float(fin.max())
+            stats["absmax"] = float(np.abs(fin).max())
+    return stats
+
+
+def tree_report(tree: Any, max_entries: int = 256) -> dict[str, Any]:
+    """Per-leaf non-finite/extremum stats for a pytree (params, grads).
+
+    Returns ``{"nonfinite_total", "leaves", "first_nonfinite",
+    "entries": {path: stats}}``; ``entries`` keeps every non-finite leaf
+    plus the largest-magnitude finite ones up to ``max_entries`` (a full
+    ResNet-50 table would be noise, the extremes are the signal)."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    rows: list[tuple[str, dict]] = []
+    total_bad = 0
+    for path, leaf in flat:
+        stats = _leaf_stats(leaf)
+        total_bad += stats["nonfinite"]
+        rows.append((_path_str(path), stats))
+    bad = [(p, s) for p, s in rows if s["nonfinite"]]
+    bad.sort(key=lambda r: _layer_sort_key(r[0]))
+    good = [(p, s) for p, s in rows if not s["nonfinite"]]
+    good.sort(key=lambda r: -r[1].get("absmax", 0.0))
+    entries = dict(bad[:max_entries])
+    for p, s in good[: max(0, max_entries - len(entries))]:
+        entries[p] = s
+    return {
+        "leaves": len(rows),
+        "nonfinite_total": total_bad,
+        "first_nonfinite": bad[0][0] if bad else None,
+        "entries": entries,
+    }
+
+
+def forward_provenance(
+    model, variables: Mapping[str, Any], images: Any, max_layers: int = 64
+) -> dict[str, Any]:
+    """One instrumented forward (flax ``capture_intermediates``) →
+    per-layer activation stats, localizing the FIRST non-finite layer in
+    (heuristic) forward order.  Replaces the ``--debug-nans`` rerun: the
+    pass runs on the already-poisoned state/batch, eagerly, host-driven.
+    """
+    from batchai_retinanet_horovod_coco_tpu.data.pipeline import (
+        normalize_images,
+    )
+
+    outputs, mutated = model.apply(
+        dict(variables),
+        normalize_images(jnp.asarray(images)),
+        train=False,
+        capture_intermediates=True,
+        mutable=["intermediates"],
+    )
+    flat, _ = jax.tree_util.tree_flatten_with_path(
+        mutated.get("intermediates", {})
+    )
+    layers: list[tuple[str, dict]] = []
+    for path, value in flat:
+        if not hasattr(value, "shape"):
+            continue
+        layers.append((_path_str(path), _leaf_stats(value)))
+    bad = [(p, s) for p, s in layers if s["nonfinite"]]
+    bad.sort(key=lambda r: _layer_sort_key(r[0]))
+    out_stats = {
+        k: _leaf_stats(v)
+        for k, v in outputs.items()
+        if hasattr(v, "shape")
+    } if isinstance(outputs, Mapping) else {}
+    return {
+        "layers_inspected": len(layers),
+        "nonfinite_layers": len(bad),
+        "first_nonfinite_layer": bad[0][0] if bad else None,
+        "layers": dict(bad[:max_layers]),
+        "outputs": out_stats,
+    }
+
+
+def provenance(
+    step: int,
+    metrics: Mapping[str, Any] | None = None,
+    params: Any | None = None,
+    model=None,
+    variables: Mapping[str, Any] | None = None,
+    images: Any | None = None,
+    image_ids: Any | None = None,
+    rng_seed: int | None = None,
+    tripped: Mapping[str, Any] | None = None,
+    cadence: str | None = None,
+) -> dict[str, Any]:
+    """Assemble the NUMERICS_DUMP payload: scalar loss terms, the param
+    tree report, and (when a model + batch are at hand) the instrumented
+    forward — each section independent, so a partially available context
+    still yields a useful dump."""
+    dump: dict[str, Any] = {
+        "event": "numerics_dump",
+        "step": int(step),
+        "tripped": dict(tripped) if tripped else None,
+        "cadence": cadence,
+        "rng_seed": rng_seed,
+    }
+    if image_ids is not None:
+        dump["batch_image_ids"] = [int(i) for i in np.asarray(image_ids)]
+        # The ids are the CHECK step's batch.  The finite-check runs at a
+        # bounded cadence, so the poison may have entered up to a full
+        # cadence window EARLIER — say so in the dump, or bad-input
+        # triage inspects innocent images (review-round finding).
+        dump["batch_image_ids_note"] = (
+            "ids are from the step at which the finite-check TRIPPED; "
+            "the non-finite value arose at or before this step"
+            + (f" (checked {cadence})" if cadence else "")
+        )
+    scalars: dict[str, float] = {}
+    if metrics:
+        for k, v in metrics.items():
+            try:
+                scalars[k] = float(np.asarray(jax.device_get(v)))
+            except (TypeError, ValueError):
+                continue
+        dump["metrics"] = scalars
+        hit = first_nonfinite_scalar(scalars)
+        dump["first_nonfinite_metric"] = hit[0] if hit else None
+    if params is not None:
+        dump["params"] = tree_report(params)
+    if model is not None and variables is not None and images is not None:
+        dump["forward"] = forward_provenance(model, variables, images)
+    # The headline: the most specific localization available.
+    fwd = dump.get("forward") or {}
+    prm = dump.get("params") or {}
+    dump["first_nonfinite"] = (
+        fwd.get("first_nonfinite_layer")
+        or prm.get("first_nonfinite")
+        or dump.get("first_nonfinite_metric")
+    )
+    return dump
+
+
+DUMP_NAME = "NUMERICS_DUMP.json"
+
+
+def write_dump(dump: Mapping[str, Any], dump_dir: str) -> str:
+    """Write ONE ``NUMERICS_DUMP.json`` into ``dump_dir`` (atomic: temp +
+    rename, so a crash mid-abort never leaves a half-written dump)."""
+    os.makedirs(dump_dir, exist_ok=True)
+    path = os.path.join(dump_dir, DUMP_NAME)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(dump, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def load_dump(path: str) -> dict[str, Any]:
+    with open(path) as f:
+        return json.load(f)
+
+
+def format_dump(dump: Mapping[str, Any]) -> str:
+    """Human triage view of a dump — the whole of ``debug.py nans``."""
+    lines = [
+        f"numerics dump: step {dump.get('step')}"
+        + (f" (checked {dump['cadence']})" if dump.get("cadence") else ""),
+    ]
+    tripped = dump.get("tripped")
+    if tripped:
+        lines.append(
+            f"tripped: {tripped.get('metric')} = {tripped.get('value')}"
+        )
+    if dump.get("first_nonfinite"):
+        lines.append(f"first non-finite: {dump['first_nonfinite']}")
+    if dump.get("batch_image_ids") is not None:
+        ids = dump["batch_image_ids"]
+        shown = ", ".join(str(i) for i in ids[:16])
+        more = f" (+{len(ids) - 16} more)" if len(ids) > 16 else ""
+        lines.append(f"batch image ids: {shown}{more}")
+        if dump.get("batch_image_ids_note"):
+            lines.append(f"  note: {dump['batch_image_ids_note']}")
+    if dump.get("rng_seed") is not None:
+        lines.append(f"rng seed: {dump['rng_seed']}")
+    metrics = dump.get("metrics") or {}
+    if metrics:
+        bad = {k: v for k, v in metrics.items() if not np.isfinite(v)}
+        lines.append(
+            "non-finite metrics: "
+            + (", ".join(f"{k}={v}" for k, v in sorted(bad.items())) or "none")
+        )
+    for section, label in (("params", "param leaves"), ("forward", "layers")):
+        sec = dump.get(section) or {}
+        n = sec.get("nonfinite_total", sec.get("nonfinite_layers"))
+        if n is None:
+            continue
+        lines.append(f"{section}: {n} non-finite {label}")
+        table = sec.get("entries") or sec.get("layers") or {}
+        for path, stats in list(table.items())[:8]:
+            if stats.get("nonfinite"):
+                lines.append(
+                    f"  {path}: {stats['nonfinite']}/{stats['size']} "
+                    f"non-finite (nan={stats.get('nan')}, "
+                    f"inf={stats.get('inf')})"
+                )
+    return "\n".join(lines)

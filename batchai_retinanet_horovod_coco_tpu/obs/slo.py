@@ -381,6 +381,51 @@ def step_time_regression(
     )
 
 
+def nonfinite_rule(metric: str = "train_nonfinite_total") -> SloRule:
+    """Fires IMMEDIATELY (no sustain, no baseline) on any non-finite
+    gradient element or tripped finite-check (ISSUE 10: the loop's
+    abort path and the in-step summary both feed the counter).  A NaN is
+    never a transient — ``for_s=0`` and the fired latch never re-arms in
+    practice because the counter is monotonic within a run."""
+    return SloRule(
+        name="train-nonfinite",
+        metric=metric,
+        op=">",
+        threshold=0.0,
+        for_s=0.0,
+        description=(
+            "non-finite values in the gradient/update stream "
+            "(NUMERICS_DUMP.json has the provenance)"
+        ),
+    )
+
+
+def grad_norm_spike(
+    factor: float = 10.0,
+    window: int = 32,
+    metric: str = "train_grad_norm",
+    for_s: float = 0.0,
+) -> SloRule:
+    """Pre-divergence tripwire: the pre-clip global gradient norm vs
+    ``factor ×`` the rolling median of its own HEALTHY history (the SLO
+    regression mode — no hand-picked absolute ceiling, and breaching
+    samples never poison the baseline).  Loose factor by default: the
+    clip chain absorbs ordinary spikes; a 10x sustained departure is the
+    loss-about-to-diverge signature worth a page."""
+    return SloRule(
+        name="grad-norm-spike",
+        metric=metric,
+        op=">",
+        baseline_window=window,
+        factor=factor,
+        for_s=for_s,
+        description=(
+            f"pre-clip grad norm above {factor}x its rolling-median "
+            "baseline"
+        ),
+    )
+
+
 #: ``--slo-rule`` grammar:  METRIC OP THRESHOLD [@FOR_S]
 #: where OP ∈ {>, >=, <, <=} and THRESHOLD is either a number (static
 #: ceiling/floor) or ``xFACTOR`` (regression vs the rolling-median

@@ -44,6 +44,7 @@ bench consistency check and the smoke's schema check use.
 from __future__ import annotations
 
 import json
+import math
 import re
 import threading
 from typing import Any, Callable, Iterable, Iterator, Mapping
@@ -560,6 +561,27 @@ def _train_handles() -> dict[str, Any]:
             "last_compile_s": r.gauge(
                 "train_last_compile_s", "build seconds of the last compile"
             ),
+            # Numerics plane (ISSUE 10, obs/numerics.py): the SLO
+            # monitor's built-in nonfinite + grad-norm-spike rules
+            # evaluate these.
+            "grad_norm": r.gauge(
+                "train_grad_norm",
+                "pre-clip global gradient norm at the last log window",
+            ),
+            "update_ratio": r.gauge(
+                "train_update_ratio",
+                "update-norm / param-norm at the last log window",
+            ),
+            "replica_agreement": r.gauge(
+                "train_replica_agreement",
+                "min/max ratio of per-replica local grad norms "
+                "(1 = replicas agree; collapsing = silent desync)",
+            ),
+            "nonfinite": r.counter(
+                "train_nonfinite_total",
+                "non-finite gradient elements observed + tripped "
+                "finite-checks (any increase is an incident)",
+            ),
         }
     return _train_gauges
 
@@ -591,6 +613,45 @@ def record_compile(bucket: str, build_s: float) -> None:
     g = _train_handles()
     g["compiles"].inc(bucket=bucket)
     g["last_compile_s"].set(round(build_s, 3))
+
+
+def record_numerics(
+    grad_norm: float | None = None,
+    update_ratio: float | None = None,
+    nonfinite: float | None = None,
+    replica_agreement: float | None = None,
+) -> None:
+    """The train loop's numerics record site (ISSUE 10; per log window —
+    the ``train_step`` gauge from ``record_train_window`` at the same
+    call site carries the step).  One bool check while telemetry is off;
+    absent fields (summary disabled, single-device run) are skipped."""
+    if not _enabled:
+        return
+    g = _train_handles()
+    if grad_norm is not None and math.isfinite(grad_norm):
+        g["grad_norm"].set(float(grad_norm))
+    if update_ratio is not None and math.isfinite(update_ratio):
+        g["update_ratio"].set(float(update_ratio))
+    if replica_agreement is not None and math.isfinite(replica_agreement):
+        g["replica_agreement"].set(float(replica_agreement))
+    if nonfinite is not None and (
+        not math.isfinite(nonfinite) or nonfinite > 0
+    ):
+        # A non-finite COUNT that is itself non-finite means the summary
+        # was poisoned — count it as one incident rather than losing it.
+        g["nonfinite"].inc(
+            float(nonfinite) if math.isfinite(nonfinite) else 1.0
+        )
+
+
+def record_nonfinite_trip(metric: str) -> None:
+    """The loop's abort-path record site: a tripped finite-check counts
+    into ``train_nonfinite_total`` (labeled by the tripped metric) so the
+    built-in nonfinite SLO rule fires even when the in-step summary was
+    off.  One bool check while telemetry is off."""
+    if not _enabled:
+        return
+    _train_handles()["nonfinite"].inc(metric=metric)
 
 
 # ---------------------------------------------------------------------------

@@ -259,6 +259,13 @@ def run_id() -> str | None:
     return _run_id
 
 
+def trace_dir() -> str | None:
+    """The configured obs artifact directory (None while disabled) — the
+    default landing spot for failure-path artifacts that belong next to
+    the trace (the numerics NUMERICS_DUMP.json, train/loop.py)."""
+    return _trace_dir if _enabled else None
+
+
 def maybe_configure_from_env(process_label: str) -> bool:
     """Child-process bring-up: enable tracing iff the parent exported
     ``RETINANET_OBS_DIR`` before the spawn.  Never re-exports the env (the

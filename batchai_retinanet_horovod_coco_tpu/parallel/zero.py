@@ -104,7 +104,9 @@ def opt_state_specs(tx: optax.GradientTransformation, params: Any, n: int) -> An
 
 
 def clip_by_global_norm_sharded(
-    max_norm: float, axis_name: str = DATA_AXIS
+    max_norm: float,
+    axis_name: str = DATA_AXIS,
+    use_precomputed: bool = True,
 ) -> optax.GradientTransformation:
     """``optax.clip_by_global_norm`` for updates living as 1/N shards.
 
@@ -121,14 +123,27 @@ def clip_by_global_norm_sharded(
         del params
         return optax.EmptyState()
 
-    def update_fn(updates, state, params=None):
-        del params
-        sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(updates))
-        norm = jnp.sqrt(lax.psum(sq, axis_name))
+    def update_fn(updates, state, params=None, *, grad_norm=None, **extra):
+        del params, extra
+        if grad_norm is None or not use_precomputed:
+            # Self-computed: psum the per-shard square sums (the shards
+            # partition the full gradient exactly; padding is zeros).
+            # ``use_precomputed=False`` FORCES this — a freeze-masked
+            # chain sees only its subtree, whose norm differs from the
+            # step's full-tree value (train/optim.py).
+            sq = sum(
+                jnp.sum(jnp.square(g)) for g in jax.tree.leaves(updates)
+            )
+            norm = jnp.sqrt(lax.psum(sq, axis_name))
+        else:
+            # sharded_update already psum-ed this exact norm for its
+            # grad_norm metric (ISSUE 10: the pre-clip norm is computed
+            # once and shared, never recomputed).
+            norm = grad_norm
         scale = max_norm / jnp.maximum(norm, max_norm)
         return jax.tree.map(lambda g: g * scale, updates), state
 
-    return optax.GradientTransformation(init_fn, update_fn)
+    return optax.GradientTransformationExtraArgs(init_fn, update_fn)
 
 
 def init_sharded_opt_state(
@@ -182,11 +197,15 @@ def sharded_update(
     sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(gshards))
     info = {"grad_norm": jnp.sqrt(lax.psum(sq, DATA_AXIS))}
     pshards = jax.tree.map(lambda p: _local_shard(p, n, index), params)
-    if loss_value is not None and isinstance(
-        tx, optax.GradientTransformationExtraArgs
-    ):
+    if isinstance(tx, optax.GradientTransformationExtraArgs):
+        # Forward the already-psum-ed pre-clip norm so the in-chain
+        # sharded clip reuses it instead of a second psum; value= feeds
+        # reduce_on_plateau when the schedule carries one.
+        extra = {"grad_norm": info["grad_norm"]}
+        if loss_value is not None:
+            extra["value"] = loss_value
         updates, new_opt_state = tx.update(
-            gshards, opt_state, pshards, value=loss_value
+            gshards, opt_state, pshards, **extra
         )
     else:
         updates, new_opt_state = tx.update(gshards, opt_state, pshards)

@@ -12,6 +12,8 @@ construction (train/state.py) — and no RedirectModel/convert step.
 from __future__ import annotations
 
 import dataclasses
+import json
+import sys
 import threading
 import warnings
 from typing import Any, Callable, Iterable, Iterator
@@ -37,8 +39,11 @@ from batchai_retinanet_horovod_coco_tpu.train.step import (
     make_train_step_spatial,
 )
 from batchai_retinanet_horovod_coco_tpu.obs import telemetry, trace, watchdog
+from batchai_retinanet_horovod_coco_tpu.obs import numerics as numerics_lib
 from batchai_retinanet_horovod_coco_tpu.obs.events import device_memory_stats
+from batchai_retinanet_horovod_coco_tpu.obs.numerics import NumericsConfig
 from batchai_retinanet_horovod_coco_tpu.obs.trace import monotonic_s
+from batchai_retinanet_horovod_coco_tpu.train.state import model_variables
 from batchai_retinanet_horovod_coco_tpu.utils.checkpoint import CheckpointManager
 from batchai_retinanet_horovod_coco_tpu.utils.metrics import MetricLogger
 
@@ -57,14 +62,95 @@ _FINITE_CHECK_EVERY = 100
 _SENTINEL_METRICS = ("loss", "param_norm")
 
 
-def _assert_finite(value, name: str, step: int, cadence: str) -> None:
-    """Numerical sanitizer (SURVEY.md §5.2): abort on a non-finite metric."""
-    if not np.isfinite(value):
-        raise FloatingPointError(
-            f"non-finite {name} ({float(value)}) at or before step {step} "
-            f"(checked {cadence}); rerun with --debug-nans to locate the "
-            "originating op"
+def _abort_nonfinite(
+    name: str,
+    value: float,
+    step: int,
+    cadence: str,
+    *,
+    model=None,
+    state=None,
+    device_arrays: dict[str, Any] | None = None,
+    image_ids=None,
+    metrics=None,
+    rng_seed: int | None = None,
+    dump_dir: str | None = None,
+    logger=None,
+) -> None:
+    """Numerical sanitizer abort (SURVEY.md §5.2), ISSUE-10 edition: run
+    the provenance pass IN-PLACE on the already-poisoned state/batch and
+    land ONE NUMERICS_DUMP.json before raising — no ``--debug-nans``
+    rerun needed.  The dump can never mask the abort: a failing
+    provenance pass degrades to one structured ``numerics_dump_error``
+    stderr line and the original FloatingPointError still raises."""
+    dump_path = None
+    first = None
+    try:
+        dump = numerics_lib.provenance(
+            step=step,
+            metrics=metrics,
+            params=state.params if state is not None else None,
+            model=model,
+            variables=(
+                model_variables(state)
+                if model is not None and state is not None
+                else None
+            ),
+            images=(device_arrays or {}).get("images"),
+            image_ids=image_ids,
+            rng_seed=rng_seed,
+            tripped={"metric": name, "value": float(value)},
+            cadence=cadence,
         )
+        first = dump.get("first_nonfinite")
+        # The file needs a configured home (--obs-dir / --log-dir / the
+        # LoopConfig field) — a bare run still gets the localization in
+        # the exception message, but never litters the cwd.
+        target_dir = dump_dir or trace.trace_dir()
+        if target_dir:
+            dump_path = numerics_lib.write_dump(dump, target_dir)
+    except Exception as e:  # the abort must land with or without a dump
+        print(
+            json.dumps(
+                {"event": "numerics_dump_error", "error": repr(e)[:500]}
+            ),
+            file=sys.stderr,
+            flush=True,
+        )
+    # The trip lands on every read surface: trace timeline instant,
+    # telemetry counter (the nonfinite SLO rule fires on it at the
+    # monitor's drain poll), structured JSONL event.
+    trace.instant(
+        "numerics_trip", metric=name, step=step, value=float(value)
+    )
+    telemetry.record_nonfinite_trip(name)
+    log_event = getattr(logger, "event", None)
+    if log_event is not None:
+        try:
+            log_event(
+                "numerics_trip",
+                metric=name,
+                step=step,
+                value=float(value),
+                dump=dump_path,
+                first_nonfinite=first,
+            )
+        except Exception:
+            pass  # a broken sink must not mask the abort
+    located = f" (first non-finite: {first})" if first else ""
+    if dump_path:
+        where = f"provenance dump at {dump_path}{located}"
+    elif first:
+        where = (
+            f"first non-finite: {first} (pass --obs-dir or --log-dir to "
+            "keep the full NUMERICS_DUMP.json)"
+        )
+    else:
+        where = "provenance dump failed — see numerics_dump_error on stderr"
+    raise FloatingPointError(
+        f"non-finite {name} ({float(value)}) at or before step {step} "
+        f"(checked {cadence}); {where}"
+    )
 
 
 @dataclasses.dataclass(frozen=True)
@@ -93,6 +179,21 @@ class LoopConfig:
     # for the safety contract; multi-process falls back to synchronous).
     # The FINAL eval stays synchronous either way.
     async_eval: bool = False
+    # Numerics flight recorder (ISSUE 10, obs/numerics.py): fuse the
+    # in-step grad/update health summary (global + per-group grad norms,
+    # update/param ratio, non-finite count, cross-replica agreement) into
+    # the compiled step.  Off (default) the compiled program and the
+    # loop's record sites are unchanged (one bool check each).  The
+    # NaN-provenance dump on a tripped finite-check is ALWAYS armed —
+    # it only ever runs on the failure path.
+    numerics: bool = False
+    # Where NUMERICS_DUMP.json lands on a tripped finite-check; default =
+    # the obs trace dir when tracing is on, else no file is written (the
+    # abort message still carries the first-non-finite localization).
+    numerics_dump_dir: str | None = None
+    # Recorded in the provenance dump (reproduction context); train.py
+    # passes --seed through.
+    rng_seed: int | None = None
 
 
 def _device_batch(batch: Batch, mesh: Mesh | None) -> dict[str, Any]:
@@ -134,8 +235,12 @@ def _device_batch(batch: Batch, mesh: Mesh | None) -> dict[str, Any]:
 
 def _prefetch_to_device(
     batches: Iterable[Batch], mesh: Mesh | None, depth: int = 2
-) -> Iterator[tuple[tuple[int, ...], dict[str, Any]]]:
-    """Yield (images_shape, device_batch), transferring ``depth`` ahead.
+) -> Iterator[tuple[tuple[int, ...], np.ndarray, dict[str, Any]]]:
+    """Yield (images_shape, image_ids, device_batch), ``depth`` ahead.
+
+    ``image_ids`` is the HOST copy of the batch's source ids — the
+    numerics provenance dump records which images fed a tripped step
+    (the device batch deliberately carries no ids).
 
     Double-buffered device prefetch (the standard ``prefetch_to_device``
     idiom): a background thread pulls host batches and calls
@@ -152,7 +257,11 @@ def _prefetch_to_device(
     """
     return prefetch_map(
         batches,
-        lambda batch: (batch.images.shape, _device_batch(batch, mesh)),
+        lambda batch: (
+            batch.images.shape,
+            batch.image_ids,
+            _device_batch(batch, mesh),
+        ),
         depth=depth,
         thread_name="device-prefetch",
     )
@@ -478,6 +587,11 @@ def run_training(
             )
         else:
             eval_runner = _AsyncEvalRunner(eval_fn, logger)
+    # Numerics flight recorder: the in-step summary gate (compile-time —
+    # the disabled step's program is unchanged) plus the always-armed
+    # provenance context for a tripped finite-check.
+    numerics_config = NumericsConfig(enabled=config.numerics)
+
     it = _prefetch_to_device(batches, mesh, config.device_prefetch)
     # The loop's own heartbeat: one beat per step.  Long legitimate gaps
     # (sync eval, final epilogue) are bracketed with idle() so only a
@@ -498,7 +612,7 @@ def run_training(
             last_step[0] = step
             t_data = monotonic_s()
             with trace.span("data_wait"):
-                images_shape, device_arrays = next(it)
+                images_shape, image_ids, device_arrays = next(it)
             window_data_wait += monotonic_s() - t_data
             window_steps += 1
             hw = images_shape[1:3]
@@ -524,6 +638,7 @@ def run_training(
                             matching_config=matching_config,
                             anchor_config=anchor_config,
                             allow_data_axis_divergence=allow_data_axis_divergence,
+                            numerics=numerics_config,
                         )
                     else:
                         step_fn = step_fns[hw] = make_train_step(
@@ -536,6 +651,7 @@ def run_training(
                             anchor_config=anchor_config,
                             shard_weight_update=shard_weight_update,
                             quantized_allreduce=quantized_allreduce,
+                            numerics=numerics_config,
                         )
                     # No process may enter the step's collectives while a
                     # peer is still compiling (collective timeouts <<
@@ -602,21 +718,43 @@ def run_training(
             cadence = (
                 f"every {check_every} steps and before each checkpoint save"
             )
+            # Both check sites — the bounded cadence check and the
+            # pre-save poisoned-state gate (``will_save``) — go through
+            # ONE finite helper (obs/numerics.first_nonfinite_scalar) and
+            # one abort path (provenance dump + raise); test_numerics
+            # pins both.
             if not is_log and (will_save or step % check_every == 0):
-                for name in _SENTINEL_METRICS:
-                    if name in metrics:
-                        _assert_finite(
-                            jax.device_get(metrics[name]), name, step, cadence
-                        )
+                sentinels = {
+                    name: jax.device_get(metrics[name])
+                    for name in _SENTINEL_METRICS
+                    if name in metrics
+                }
+                hit = numerics_lib.first_nonfinite_scalar(sentinels)
+                if hit is not None:
+                    _abort_nonfinite(
+                        hit[0], hit[1], step, cadence,
+                        model=model, state=state,
+                        device_arrays=device_arrays, image_ids=image_ids,
+                        metrics=metrics, rng_seed=config.rng_seed,
+                        dump_dir=config.numerics_dump_dir, logger=logger,
+                    )
 
             if is_log:
                 with trace.span("metrics_fetch"):
                     scalars = {
                         k: v for k, v in jax.device_get(metrics).items()
                     }
-                for name in _SENTINEL_METRICS:
-                    if name in scalars:
-                        _assert_finite(scalars[name], name, step, cadence)
+                hit = numerics_lib.first_nonfinite_scalar(
+                    {k: scalars[k] for k in _SENTINEL_METRICS if k in scalars}
+                )
+                if hit is not None:
+                    _abort_nonfinite(
+                        hit[0], hit[1], step, cadence,
+                        model=model, state=state,
+                        device_arrays=device_arrays, image_ids=image_ids,
+                        metrics=metrics, rng_seed=config.rng_seed,
+                        dump_dir=config.numerics_dump_dir, logger=logger,
+                    )
                 dt = monotonic_s() - window_t0
                 scalars["images_per_sec"] = window_images / max(dt, 1e-9)
                 # Step-time breakdown (SURVEY.md §5.5): how much of the step the
@@ -647,6 +785,30 @@ def run_training(
                     step_time_ms=scalars["step_time_ms"],
                     data_wait_ms=scalars["data_wait_ms"],
                 )
+                # Numerics record sites (ISSUE 10; each one bool check
+                # while its plane is off): the grad_norm/update_ratio/
+                # nonfinite gauges feed the SLO monitor's built-in
+                # nonfinite + grad-norm-spike rules whenever telemetry
+                # is live; the dedicated structured JSONL record (the
+                # perf doctor's numerics section) exists only when the
+                # in-step summary is on.
+                telemetry.record_numerics(
+                    grad_norm=scalars.get(numerics_lib.GRAD_NORM),
+                    update_ratio=scalars.get(numerics_lib.UPDATE_RATIO),
+                    nonfinite=scalars.get(numerics_lib.NONFINITE),
+                    replica_agreement=scalars.get(
+                        numerics_lib.REPLICA_AGREEMENT
+                    ),
+                )
+                if config.numerics:
+                    num_keys = numerics_lib.numerics_metric_keys(scalars)
+                    log_event = getattr(logger, "event", None)
+                    if log_event is not None and num_keys:
+                        log_event(
+                            "numerics",
+                            step=step,
+                            **{k: float(scalars[k]) for k in num_keys},
+                        )
                 if trace.enabled():
                     # Device HBM occupancy as Chrome counter tracks, once
                     # per log window (memory_stats() is a host call; CPU

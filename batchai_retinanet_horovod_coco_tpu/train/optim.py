@@ -14,7 +14,48 @@ from __future__ import annotations
 import dataclasses
 
 import jax
+import jax.numpy as jnp
 import optax
+
+
+def clip_by_global_norm_precomputed(
+    max_norm: float,
+) -> optax.GradientTransformationExtraArgs:
+    """``optax.clip_by_global_norm`` that can REUSE a precomputed norm.
+
+    The train step already computes ``optax.global_norm(grads)`` for its
+    ``grad_norm`` metric (SURVEY.md §5.5); the stock optax clip then
+    recomputed the identical reduction inside the chain.  This transform
+    accepts the step's value via extra args (``grad_norm=...``, forwarded
+    by ``optax.chain``/``multi_transform`` — TrainState.apply_gradients
+    passes it), so the metric and the clip share ONE reduction, and the
+    recorded pre-clip norm is BY CONSTRUCTION the norm the clip acted on
+    (the numerics plane's contract, obs/numerics.py).  Without the extra
+    arg it computes the norm itself — identical semantics either way
+    (``scale = max_norm / max(norm, max_norm)``, the same rule as
+    ``clip_by_global_norm_sharded``; equivalence pinned by
+    tests/unit/test_numerics.py).
+
+    NOT safe under ``optax.multi_transform`` masking: the masked branch
+    sees only its subtree's updates, while the step's precomputed norm
+    covers the FULL tree — forwarding it would clip trained params by a
+    norm inflated with frozen gradients (a ~200x effective-LR collapse
+    in a freeze-backbone run with large frozen grads).  ``make_optimizer``
+    therefore keeps the stock self-computing clip whenever
+    ``freeze_backbone`` masks the chain (pinned by test_numerics).
+    """
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None, *, grad_norm=None, **extra):
+        del params, extra
+        norm = optax.global_norm(updates) if grad_norm is None else grad_norm
+        scale = max_norm / jnp.maximum(norm, max_norm)
+        return jax.tree.map(lambda u: u * scale, updates), state
+
+    return optax.GradientTransformationExtraArgs(init_fn, update_fn)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -105,14 +146,28 @@ def make_optimizer(
     else:
         raise ValueError(f"unknown optimizer: {config.optimizer!r}")
 
+    # The freeze-masked chain must NOT consume the step's precomputed
+    # norm: inside multi_transform the clip sees only the trained
+    # subtree, and the full-tree norm (which includes the frozen
+    # backbone's gradients) would silently over-clip it — see
+    # clip_by_global_norm_precomputed's docstring.  The frozen chain
+    # keeps the self-computing clips (extra args are dropped for plain
+    # transforms, so the step's grad_norm= is harmlessly ignored).
+    use_precomputed = not config.freeze_backbone
     if shard_clip_axis is not None:
         from batchai_retinanet_horovod_coco_tpu.parallel.zero import (
             clip_by_global_norm_sharded,
         )
 
         clip = clip_by_global_norm_sharded(
-            config.clip_global_norm, shard_clip_axis
+            config.clip_global_norm, shard_clip_axis,
+            use_precomputed=use_precomputed,
         )
+    elif use_precomputed:
+        # Accepts the step's precomputed global norm via extra args so the
+        # grad_norm metric and the clip share one reduction (identical
+        # semantics to optax.clip_by_global_norm otherwise).
+        clip = clip_by_global_norm_precomputed(config.clip_global_norm)
     else:
         clip = optax.clip_by_global_norm(config.clip_global_norm)
     tx = optax.chain(clip, core)

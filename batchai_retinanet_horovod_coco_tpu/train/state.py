@@ -50,19 +50,27 @@ class TrainState:
         new_batch_stats: Any | None = None,
         *,
         loss_value: jnp.ndarray | None = None,
+        grad_norm: jnp.ndarray | None = None,
     ):
         """One optimizer update.
 
         ``loss_value`` (the replica-identical pmean-ed loss) is forwarded to
         extra-args transforms — optax.contrib.reduce_on_plateau consumes it
         as ``value`` (train/optim.py "plateau" schedule); plain transforms
-        never see it.
+        never see it.  ``grad_norm`` (the step's precomputed global norm)
+        likewise reaches ``clip_by_global_norm_precomputed`` so the metric
+        and the clip share one reduction (obs/numerics.py contract).
         """
-        if loss_value is not None and isinstance(
-            self.tx, optax.GradientTransformationExtraArgs
+        if isinstance(self.tx, optax.GradientTransformationExtraArgs) and (
+            loss_value is not None or grad_norm is not None
         ):
+            extra = {}
+            if loss_value is not None:
+                extra["value"] = loss_value
+            if grad_norm is not None:
+                extra["grad_norm"] = grad_norm
             updates, new_opt_state = self.tx.update(
-                grads, self.opt_state, self.params, value=loss_value
+                grads, self.opt_state, self.params, **extra
             )
         else:
             updates, new_opt_state = self.tx.update(

@@ -31,6 +31,8 @@ from batchai_retinanet_horovod_coco_tpu.parallel.shmap import (
 
 from batchai_retinanet_horovod_coco_tpu import losses as losses_lib
 from batchai_retinanet_horovod_coco_tpu.data import pipeline as pipeline_lib
+from batchai_retinanet_horovod_coco_tpu.obs import numerics as numerics_lib
+from batchai_retinanet_horovod_coco_tpu.obs.numerics import NumericsConfig
 from batchai_retinanet_horovod_coco_tpu.ops import anchors as anchors_lib
 from batchai_retinanet_horovod_coco_tpu.ops import matching as matching_lib
 from batchai_retinanet_horovod_coco_tpu.parallel.mesh import DATA_AXIS
@@ -174,7 +176,7 @@ def _make_local_step(model, anchors, loss_config, matching_config):
     return local_step
 
 
-def _global_math_step(local_step):
+def _global_math_step(local_step, numerics: NumericsConfig | None = None):
     """Plain global-batch step body: grads → metrics → update.
 
     Serves both the single-device step (jit) and the spatially partitioned
@@ -182,13 +184,18 @@ def _global_math_step(local_step):
     reductions into collectives) — ONE definition so metrics/update changes
     cannot drift between them.
     """
+    numerics = numerics or NumericsConfig()
 
     def train_step(state: TrainState, batch: dict[str, Any]):
         grads, metrics, new_bs = local_step(state, batch)
-        # SURVEY.md §5.5: grad-norm is a first-class per-step metric.
-        metrics["grad_norm"] = optax.global_norm(grads)
+        # SURVEY.md §5.5: grad-norm is a first-class per-step metric —
+        # computed ONCE here and fed to the clip chain via extra args
+        # (clip_by_global_norm_precomputed), so the recorded value IS the
+        # pre-clip norm the clip acted on, never a recomputation.
+        gnorm = optax.global_norm(grads)
+        metrics["grad_norm"] = gnorm
         new_state = state.apply_gradients(
-            grads, new_bs, loss_value=metrics["loss"]
+            grads, new_bs, loss_value=metrics["loss"], grad_norm=gnorm
         )
         # Norm of the POST-update params: the loss above was computed
         # from the pre-update params, so it cannot witness a poisoned
@@ -196,6 +203,15 @@ def _global_math_step(local_step):
         # checkpoint save (a norm read of params the next step reloads
         # anyway; cost is noise).
         metrics["param_norm"] = optax.global_norm(new_state.params)
+        if numerics.enabled:
+            # In-step numerics summary (ISSUE 10): ~2 extra reduces; the
+            # disabled step's HLO is unchanged (trace-time Python gate).
+            metrics.update(
+                numerics_lib.step_summary(
+                    grads, state.params, new_state.params,
+                    metrics["param_norm"], numerics,
+                )
+            )
         return new_state, metrics
 
     return train_step
@@ -212,6 +228,7 @@ def make_train_step(
     donate_state: bool = True,
     shard_weight_update: bool = False,
     quantized_allreduce: bool = False,
+    numerics: NumericsConfig | None = None,
 ) -> Callable[[TrainState, dict[str, Any]], tuple[TrainState, dict[str, jnp.ndarray]]]:
     """Build the jitted train step for one shape bucket.
 
@@ -236,10 +253,16 @@ def make_train_step(
     all-reduce, error bounded by one rounding of the already-reduced
     gradient.  SURVEY.md §5.8's optional EQuARX-style optimization.
 
+    ``numerics`` (obs/numerics.py): enable the fused in-step numerics
+    summary — update/param ratio, non-finite gradient count, per-layer-
+    group norms, and (mesh steps) the cross-replica agreement probe.
+    Disabled (the default) the compiled program is unchanged.
+
     The returned callable takes (state, batch_dict) where batch_dict holds
     ``images, gt_boxes, gt_labels, gt_mask`` (leading axis = GLOBAL batch)
     and returns (new_state, metrics).
     """
+    numerics = numerics or NumericsConfig()
     if shard_weight_update and mesh is None:
         raise ValueError("shard_weight_update requires a mesh")
     if quantized_allreduce and mesh is None:
@@ -263,7 +286,7 @@ def make_train_step(
 
     if mesh is None:
         return jax.jit(
-            _global_math_step(local_step),
+            _global_math_step(local_step, numerics),
             donate_argnums=(0,) if donate_state else (),
         )
 
@@ -300,6 +323,15 @@ def make_train_step(
             )
             def zero_step(state: TrainState, batch: dict[str, Any]):
                 grads, metrics, new_bs = local_step(state, batch)
+                if numerics.enabled and numerics.replica_agreement:
+                    # Cross-replica probe on the LOCAL pre-reduce grads:
+                    # a desynced replica's local norm diverges from its
+                    # peers' long before the (averaged) loss shows it.
+                    metrics["replica_agreement"] = (
+                        numerics_lib.replica_agreement(
+                            optax.global_norm(grads), DATA_AXIS
+                        )
+                    )
                 metrics = reduce_metrics(metrics)
                 if state.batch_stats:
                     new_bs = lax.pmean(new_bs, DATA_AXIS)
@@ -317,6 +349,28 @@ def make_train_step(
                 # Post-update param norm (see the single-device step): the
                 # gathered new_params are replicated, so the norm is too.
                 metrics["param_norm"] = optax.global_norm(new_params)
+                if numerics.enabled:
+                    # Hand-assembled summary: the reduced gradient only
+                    # ever exists as 1/N shards here, so the non-finite
+                    # count psums the LOCAL counts (a NaN anywhere
+                    # poisons the reduce-scatter, so local detection is
+                    # global detection) and group norms are the pmean of
+                    # per-replica local-grad norms; params are
+                    # replicated, so the update ratio is the same math
+                    # as the replicated step's.
+                    metrics["nonfinite_grads"] = lax.psum(
+                        numerics_lib.nonfinite_count(grads), DATA_AXIS
+                    )
+                    metrics["update_ratio"] = numerics_lib.update_ratio(
+                        state.params, new_params, metrics["param_norm"]
+                    )
+                    if numerics.per_group:
+                        for key, norm in numerics_lib.group_norms(
+                            grads
+                        ).items():
+                            metrics[f"gnorm/{key}"] = lax.pmean(
+                                norm, DATA_AXIS
+                            )
                 new_state = state.replace(
                     step=state.step + 1,
                     params=new_params,
@@ -364,6 +418,13 @@ def make_train_step(
     )
     def sharded_step(state: TrainState, batch: dict[str, Any]):
         grads, metrics, new_bs = local_step(state, batch)
+        if numerics.enabled and numerics.replica_agreement:
+            # Cross-replica probe BEFORE the allreduce: per-replica local
+            # norms vs the axis min/max — the silent-desync detector the
+            # averaged gradients cannot provide (obs/numerics.py).
+            metrics["replica_agreement"] = numerics_lib.replica_agreement(
+                optax.global_norm(grads), DATA_AXIS
+            )
         # THE allreduce: Horovod's NCCL ring → one compiled pmean over ICI
         # (optionally with an int8-compressed gather phase).
         if quantized_allreduce:
@@ -375,14 +436,26 @@ def make_train_step(
         num_pos = lax.psum(metrics["num_pos"], DATA_AXIS)  # a count, not a mean
         metrics = lax.pmean(metrics, DATA_AXIS)
         metrics["num_pos"] = num_pos
-        metrics["grad_norm"] = optax.global_norm(grads)
+        # Pre-clip global norm, computed once and shared with the clip
+        # chain via extra args (clip_by_global_norm_precomputed).
+        gnorm = optax.global_norm(grads)
+        metrics["grad_norm"] = gnorm
         if state.batch_stats:
             new_bs = lax.pmean(new_bs, DATA_AXIS)  # sync-BN semantics
         new_state = state.apply_gradients(
-            grads, new_bs, loss_value=metrics["loss"]
+            grads, new_bs, loss_value=metrics["loss"], grad_norm=gnorm
         )
         # Post-update param norm (see the single-device step for why).
         metrics["param_norm"] = optax.global_norm(new_state.params)
+        if numerics.enabled:
+            # Post-allreduce grads + params are replicated, so the shared
+            # summary is replicated-out safe here.
+            metrics.update(
+                numerics_lib.step_summary(
+                    grads, state.params, new_state.params,
+                    metrics["param_norm"], numerics,
+                )
+            )
         return new_state, metrics
 
     return jax.jit(sharded_step, donate_argnums=(0,) if donate_state else ())
@@ -453,6 +526,7 @@ def make_train_step_spatial(
     allow_degenerate_spatial_sharding: bool = False,
     allow_unvalidated_bf16: bool = False,
     allow_data_axis_divergence: bool = False,
+    numerics: NumericsConfig | None = None,
 ) -> Callable[[TrainState, dict[str, Any]], tuple[TrainState, dict[str, jnp.ndarray]]]:
     """Train step with the IMAGE sharded across chips (spatial partitioning).
 
@@ -619,8 +693,12 @@ def make_train_step_spatial(
             image_hw, anchor_config or anchors_lib.AnchorConfig()
         )
     )
+    # Numerics summary rides the global-math body (grads are global under
+    # GSPMD); the per-replica agreement probe needs a named axis shard_map
+    # does not exist here, so it is structurally absent on this path.
     train_step = _global_math_step(
-        _make_local_step(model, anchors, loss_config, matching_config)
+        _make_local_step(model, anchors, loss_config, matching_config),
+        numerics,
     )
 
     from batchai_retinanet_horovod_coco_tpu.parallel.mesh import (

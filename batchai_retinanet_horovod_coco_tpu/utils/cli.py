@@ -129,6 +129,22 @@ def add_obs_flags(parser) -> None:
                              "a watchdog-stall rule is always included")
     parser.add_argument("--slo-poll-s", type=float, default=5.0,
                         help="SLO monitor poll interval (seconds)")
+    # Numerics flight recorder (ISSUE 10, obs/numerics.py)
+    parser.add_argument("--numerics", action="store_true",
+                        help="fuse the in-step numerics summary into the "
+                             "compiled train step: pre-clip global + "
+                             "per-layer-group gradient norms, update/"
+                             "param ratio, non-finite count, and the "
+                             "cross-replica agreement probe on mesh "
+                             "runs (~2 extra global reduces per step; "
+                             "the summary lands in metrics.jsonl as "
+                             "structured 'numerics' records, in the "
+                             "telemetry gauges the built-in nonfinite/"
+                             "grad-norm-spike SLO rules watch, and in "
+                             "PERF_REPORT's numerics section).  The "
+                             "NaN-provenance NUMERICS_DUMP.json on a "
+                             "tripped finite-check is always armed, "
+                             "with or without this flag")
 
 
 def add_serve_flags(parser) -> None:

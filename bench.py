@@ -399,10 +399,14 @@ def run_bench(
     batch_size: int,
     hw: tuple[int, int] = BUCKET,
     measure_steps: int = MEASURE_STEPS,
+    numerics: bool = False,
 ) -> tuple[float, float | None]:
     from batchai_retinanet_horovod_coco_tpu.models import (
         RetinaNetConfig,
         build_retinanet,
+    )
+    from batchai_retinanet_horovod_coco_tpu.obs.numerics import (
+        NumericsConfig,
     )
     from batchai_retinanet_horovod_coco_tpu.train import (
         create_train_state,
@@ -421,7 +425,13 @@ def run_bench(
     state = create_train_state(
         model, optax.sgd(0.01, momentum=0.9), (1, *hw, 3), jax.random.key(0)
     )
-    step = make_train_step(model, hw, 80, donate_state=True)
+    # numerics=True measures the ISSUE-10 in-step summary's overhead
+    # (the committed JSON line's numerics_overhead field states the
+    # on-vs-off delta); the default step is byte-identical to pre-ISSUE-10.
+    step = make_train_step(
+        model, hw, 80, donate_state=True,
+        numerics=NumericsConfig(enabled=numerics),
+    )
     batch = make_batch(batch_size, hw)
 
     # AOT-compile once: the executable both runs the loop and reports the
@@ -1323,6 +1333,25 @@ def run_train_mode() -> None:
     from batchai_retinanet_horovod_coco_tpu.tune import provenance
 
     out["schedule"] = provenance(out["device_kind"])
+
+    # Numerics-plane overhead evidence (ISSUE 10): re-measure the SAME
+    # flagship config with the in-step summary fused in and state the
+    # on-vs-off delta in the committed line.  BENCH_NUMERICS=0 skips
+    # (the check targets — the extra AOT compile is minutes on CPU).
+    if os.environ.get("BENCH_NUMERICS", "1") not in ("", "0"):
+        ips_on, _mfu_on, _win_on = run_bench(
+            flag_batch, BUCKET, measure_steps, numerics=True
+        )
+        out["numerics_overhead"] = {
+            "imgs_per_sec_off": value,
+            "imgs_per_sec_on": round(ips_on, 3),
+            "delta_pct": round((value - ips_on) / value * 100, 2),
+            "note": (
+                "in-step numerics summary (obs/numerics.py) on vs off; "
+                "delta within noise_pct is noise.  Disabled path is "
+                "structurally free (identical compiled step)"
+            ),
+        }
 
     att = _trace_attribution()
     if att is not None:

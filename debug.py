@@ -11,6 +11,14 @@ Usage:
   python debug.py coco /data/coco [--limit 8] [--output-dir /tmp/vis]
   python debug.py synthetic [--limit 8]
   python debug.py buckets /data/coco/annotations/instances_train2017.json
+  python debug.py nans NUMERICS_DUMP.json
+
+``nans`` is the numerics-triage driver (ISSUE 10): pretty-print the
+NUMERICS_DUMP.json the train loop's abort path landed (obs/numerics.py
+``provenance`` — first non-finite layer/loss term, batch source ids,
+per-layer stats; no ``--debug-nans`` rerun was needed to produce it).
+The localization logic lives ENTIRELY in obs/numerics.py — this
+subcommand is a thin formatter over ``load_dump``/``format_dump``.
 
 ``buckets`` derives the EXACT static-bucket shares for a dataset from the
 annotation file alone (COCO records carry width/height; nothing is
@@ -42,6 +50,14 @@ def build_parser() -> argparse.ArgumentParser:
     synth.add_argument("--synthetic-root", default="/tmp/synthetic_coco_debug")
     synth.add_argument("--synthetic-images", type=int, default=8)
     synth.add_argument("--synthetic-size", type=int, default=256)
+    nans = sub.add_parser(
+        "nans", help="triage a NUMERICS_DUMP.json (obs/numerics.py)"
+    )
+    nans.add_argument("dump_file", help="path to a NUMERICS_DUMP.json "
+                      "written by the train loop's non-finite abort")
+    nans.add_argument("--json", action="store_true", dest="as_json",
+                      help="re-emit the dump as one JSON line (machine "
+                           "consumers) instead of the human triage view")
     bk = sub.add_parser("buckets")
     bk.add_argument("annotation_file")
     bk.add_argument("--image-min-side", type=int, default=800)
@@ -159,11 +175,27 @@ def _run_buckets(args) -> dict:
     return out
 
 
+def _run_nans(args) -> dict:
+    """Thin driver over obs/numerics.py — no tree-walk lives here."""
+    import json
+
+    from batchai_retinanet_horovod_coco_tpu.obs import numerics
+
+    dump = numerics.load_dump(args.dump_file)
+    if args.as_json:
+        print(json.dumps(dump, sort_keys=True))
+    else:
+        print(numerics.format_dump(dump))
+    return dump
+
+
 def main(argv=None) -> list[dict]:
     args = build_parser().parse_args(argv)
     # Host debugging tool: tiny per-image ops, not worth a TPU round trip.
     jax.config.update("jax_platforms", "cpu")
 
+    if args.dataset_type == "nans":
+        return [_run_nans(args)]
     if args.dataset_type == "buckets":
         return [_run_buckets(args)]
 

@@ -1,0 +1,283 @@
+#!/usr/bin/env python
+"""numerics-smoke: CPU train smoke with an injected NaN (ISSUE 10).
+
+The CI leg of the numerics flight recorder (``make numerics-smoke``,
+part of ``check-static``): run a real ``run_training`` loop — obs trace,
+events JSONL sink, telemetry registry, and SLO monitor all live — inject
+a NaN into one mid-run batch, and assert the acceptance contract WITHOUT
+any rerun:
+
+- the abort lands ONE ``NUMERICS_DUMP.json`` naming the first
+  non-finite layer (the provenance pass replaced ``--debug-nans``);
+- the built-in nonfinite SLO rule fires EXACTLY ONCE, visible as one
+  ``slo_violation`` record in metrics.jsonl AND one instant on the
+  trace timeline, plus the ``numerics_trip`` marker;
+- the auto-emitted PERF_REPORT.json is schema-valid, its numerics
+  section is populated, and the ``numerics:divergence`` verdict ranks
+  #1 — above every SLO and inferred bottleneck;
+- the disabled-path contract holds structurally: with numerics off, the
+  step's metrics carry no summary keys.
+
+Exit 0 on success; any failed check prints one ``numerics-smoke FAIL:``
+line and exits 1.  Stdout ends with one machine-readable JSON summary.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:  # direct `python scripts/numerics_smoke.py` runs
+    sys.path.insert(0, _REPO)
+
+_failures: list[str] = []
+
+
+def check(ok: bool, what: str) -> None:
+    if not ok:
+        _failures.append(what)
+        print(f"numerics-smoke FAIL: {what}", flush=True)
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from batchai_retinanet_horovod_coco_tpu import obs
+    from batchai_retinanet_horovod_coco_tpu.data.pipeline import Batch
+    from batchai_retinanet_horovod_coco_tpu.models import (
+        RetinaNetConfig,
+        build_retinanet,
+    )
+    from batchai_retinanet_horovod_coco_tpu.obs import slo, telemetry
+    from batchai_retinanet_horovod_coco_tpu.obs.analyze import (
+        auto_emit,
+        validate_report,
+    )
+    from batchai_retinanet_horovod_coco_tpu.obs.events import (
+        EventSink,
+        split_runs,
+    )
+    from batchai_retinanet_horovod_coco_tpu.train import create_train_state
+    from batchai_retinanet_horovod_coco_tpu.train.loop import (
+        LoopConfig,
+        run_training,
+    )
+
+    hw, batch_size = (64, 64), 4
+    obs_dir = tempfile.mkdtemp(prefix="numerics_smoke_")
+    try:
+        obs.enable(obs_dir, process_label="numerics-smoke")
+        logger = EventSink(obs_dir, stdout=False)
+        telemetry.reset()
+        telemetry.enable()
+        monitor = slo.SloMonitor(
+            telemetry.default(),
+            [slo.nonfinite_rule(), slo.grad_norm_spike()],
+            sink=logger,
+            poll_interval=0.2,
+        ).start()
+
+        model = build_retinanet(
+            RetinaNetConfig(
+                num_classes=3, backbone="resnet_test", fpn_channels=16,
+                head_width=16, head_depth=1, dtype=jnp.float32,
+            )
+        )
+        state = create_train_state(
+            model, optax.sgd(1e-3, momentum=0.9), (1, *hw, 3),
+            jax.random.key(0),
+        )
+
+        def stream(nan_at_step: int = 3):
+            rng = np.random.default_rng(0)
+            i = 0
+            while True:
+                i += 1
+                images = rng.normal(0, 1, (batch_size, *hw, 3)).astype(
+                    np.float32
+                )
+                if i == nan_at_step:
+                    images[0, 0, 0, 0] = np.nan  # the injected poison
+                yield Batch(
+                    images=images,
+                    gt_boxes=np.tile(
+                        np.array([[8.0, 8.0, 40.0, 40.0]], np.float32),
+                        (batch_size, 1, 1),
+                    ),
+                    gt_labels=np.ones((batch_size, 1), np.int32),
+                    gt_mask=np.ones((batch_size, 1), bool),
+                    image_ids=np.arange(batch_size, dtype=np.int64)
+                    + i * 100,
+                    scales=np.ones((batch_size,), np.float32),
+                    valid=np.ones((batch_size,), bool),
+                )
+
+        aborted = False
+        try:
+            run_training(
+                model, state, stream(), 3,
+                LoopConfig(
+                    total_steps=8, log_every=1, numerics=True,
+                    numerics_dump_dir=obs_dir, rng_seed=0,
+                ),
+                logger=logger,
+            )
+        except FloatingPointError as e:
+            aborted = True
+            print(f"# abort (expected): {e}", flush=True)
+        check(aborted, "injected NaN did not abort the loop")
+
+        # Drain: the monitor's stop() runs one final evaluation, so the
+        # end-of-run breach fires even on a sub-poll-interval run; the
+        # fired latch guarantees it fired EXACTLY once overall.
+        monitor.stop()
+        monitor.check_once()  # must NOT re-fire (latched breach)
+        logger.close()
+        obs.finalize()
+
+        # 1. ONE provenance dump naming the first non-finite layer.
+        dump_path = os.path.join(obs_dir, "NUMERICS_DUMP.json")
+        check(os.path.exists(dump_path), "NUMERICS_DUMP.json missing")
+        dumps = [
+            f for f in os.listdir(obs_dir) if f.startswith("NUMERICS_DUMP")
+        ]
+        check(len(dumps) == 1, f"expected ONE dump, found {dumps}")
+        first = None
+        if os.path.exists(dump_path):
+            with open(dump_path) as f:
+                dump = json.load(f)
+            first = dump.get("first_nonfinite")
+            check(bool(first), "dump names no first non-finite layer")
+            check(
+                "backbone" in str(first),
+                f"NaN images should localize to the backbone, got {first!r}",
+            )
+            check(
+                bool(dump.get("batch_image_ids")),
+                "dump carries no batch source ids",
+            )
+
+        # 2. EXACTLY ONE nonfinite slo_violation in metrics.jsonl.
+        runs = split_runs(os.path.join(obs_dir, "metrics.jsonl"))
+        records = runs[-1]["records"] if runs else []
+        violations = [
+            r
+            for r in records
+            if r.get("event") == "slo_violation"
+            and r.get("rule") == "train-nonfinite"
+        ]
+        check(
+            len(violations) == 1,
+            f"expected exactly one train-nonfinite slo_violation, "
+            f"got {len(violations)}",
+        )
+        trips = [r for r in records if r.get("event") == "numerics_trip"]
+        check(len(trips) == 1, f"expected one numerics_trip, got {len(trips)}")
+        numerics_records = [
+            r for r in records if r.get("event") == "numerics"
+        ]
+        check(
+            len(numerics_records) >= 1,
+            "no structured numerics records reached metrics.jsonl",
+        )
+
+        # 3. The trip + violation sit ON the trace timeline.
+        with open(os.path.join(obs_dir, "trace.json")) as f:
+            trace_doc = json.load(f)
+        instants = [
+            e
+            for e in trace_doc.get("traceEvents", [])
+            if e.get("ph") == "i"
+        ]
+        slo_markers = [
+            e
+            for e in instants
+            if e.get("name") == "slo_violation"
+            and (e.get("args") or {}).get("rule") == "train-nonfinite"
+        ]
+        check(
+            len(slo_markers) == 1,
+            f"expected one slo_violation trace instant, got "
+            f"{len(slo_markers)}",
+        )
+        check(
+            any(e.get("name") == "numerics_trip" for e in instants),
+            "no numerics_trip instant on the trace timeline",
+        )
+
+        # 4. PERF_REPORT: schema-valid, numerics populated, divergence #1.
+        report_path = auto_emit(obs_dir)
+        check(bool(report_path), "auto_emit produced no PERF_REPORT")
+        if report_path:
+            with open(report_path) as f:
+                report = json.load(f)
+            problems = validate_report(report)
+            check(not problems, f"report schema problems: {problems}")
+            num = report.get("numerics") or {}
+            check(num.get("available"), "report numerics section empty")
+            check(
+                (num.get("trips") or {}).get("count", 0) >= 1,
+                "report numerics section saw no trip",
+            )
+            bn = report.get("bottlenecks") or [{}]
+            check(
+                bn[0].get("name") == "numerics:divergence",
+                f"divergence verdict not ranked #1 (got {bn[0].get('name')})",
+            )
+
+        # 5. Disabled-path contract: numerics off adds no summary keys.
+        from batchai_retinanet_horovod_coco_tpu.train.step import (
+            make_train_step,
+        )
+
+        step_off = make_train_step(model, hw, 3, donate_state=False)
+        batch0 = next(iter(stream(nan_at_step=0)))
+        # Fresh state: the loop's step donated the original one.
+        fresh = create_train_state(
+            model, optax.sgd(1e-3, momentum=0.9), (1, *hw, 3),
+            jax.random.key(1),
+        )
+        _, metrics_off = step_off(
+            fresh,
+            {
+                "images": jnp.asarray(batch0.images),
+                "gt_boxes": jnp.asarray(batch0.gt_boxes),
+                "gt_labels": jnp.asarray(batch0.gt_labels),
+                "gt_mask": jnp.asarray(batch0.gt_mask),
+            },
+        )
+        check(
+            "update_ratio" not in metrics_off
+            and not any(k.startswith("gnorm/") for k in metrics_off),
+            "numerics-off step leaked summary keys",
+        )
+
+        print(
+            json.dumps(
+                {
+                    "numerics_smoke": "ok" if not _failures else "FAIL",
+                    "failures": _failures,
+                    "first_nonfinite": first,
+                    "slo_violations": len(violations),
+                    "obs_dir": obs_dir,
+                }
+            ),
+            flush=True,
+        )
+        return 1 if _failures else 0
+    finally:
+        telemetry.reset()
+        if not _failures:
+            shutil.rmtree(obs_dir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -441,7 +441,14 @@ def _start_telemetry(args, logger):
         )
     monitor = slo.SloMonitor(
         telemetry.default(),
-        [slo.stall_rule()] + [slo.parse_rule(s) for s in rule_specs],
+        # Built-ins first: the watchdog-stall rule, the immediate
+        # nonfinite rule (ISSUE 10 — fed by the loop's abort path and
+        # the in-step numerics summary), and the grad-norm-spike
+        # regression rule (rolling-median baseline; silent until the
+        # train_grad_norm gauge exists, so serve/eval runs are
+        # unaffected).  User --slo-rule specs append after.
+        [slo.stall_rule(), slo.nonfinite_rule(), slo.grad_norm_spike()]
+        + [slo.parse_rule(s) for s in rule_specs],
         sink=logger,
         poll_interval=getattr(args, "slo_poll_s", 5.0),
     ).start()
@@ -900,6 +907,17 @@ def _run(args) -> dict[str, float]:
                     profile_dir=args.profile_dir,
                     device_prefetch=args.device_prefetch,
                     async_eval=args.async_eval,
+                    # Numerics flight recorder (obs/numerics.py): the
+                    # in-step summary gate; the provenance dump lands in
+                    # the obs dir (or --log-dir without one) on a
+                    # tripped finite-check either way.
+                    numerics=getattr(args, "numerics", False),
+                    numerics_dump_dir=(
+                        getattr(args, "obs_dir", None)
+                        or args.log_dir
+                        or None
+                    ),
+                    rng_seed=args.seed,
                 ),
                 mesh=mesh,
                 schedule=schedule,

@@ -23,7 +23,7 @@ CLUSTER = python -m batchai_retinanet_horovod_coco_tpu.launch.cluster
 	evalbench-check servebench servebench-check canaries \
 	convergence-full lint lint-obs check-static tune-smoke tunebench \
 	tunebench-check perf-report perf-report-check telemetry-smoke \
-	numerics-smoke
+	numerics-smoke chaos chaos-smoke ckptbench ckptbench-check
 
 create:
 	$(CLUSTER) create --name $(NAME) --zone $(ZONE) --accelerator $(ACCEL) $(DRYFLAG)
@@ -138,10 +138,41 @@ telemetry-smoke:
 numerics-smoke:
 	JAX_PLATFORMS=cpu python scripts/numerics_smoke.py
 
-# bench-check-style aggregate for everything static: one target CI can run
-# without touching a chip or a dataset.
-check-static: lint telemetry-smoke numerics-smoke
-	@echo "check-static: lint engine + watchdog audit + HLO collective audit + telemetry smoke + numerics smoke all green"
+# Fault-injection harness (ISSUE 11, scripts/chaos.py): SIGKILL a real
+# CPU training subprocess at every phase of the checkpoint write protocol
+# (snapshot, tmp-write, manifest-commit, rename, finalize — >= 20
+# scheduled kills) plus mid-step external kills, manufactured torn
+# checkpoint dirs, and an injected-NaN --auto-resume leg; asserts a
+# restorable checkpoint survives EVERY kill and the resumed run's losses
+# are bit-identical to an uninterrupted baseline (--resume-elastic
+# re-derives the stream position).  chaos-smoke is the bounded CI leg
+# (one mid-save kill + the NaN leg, ~4 subprocess runs).
+chaos:
+	JAX_PLATFORMS=cpu python scripts/chaos.py
+
+chaos-smoke:
+	JAX_PLATFORMS=cpu python scripts/chaos.py --smoke
+
+# CKPTBENCH (ISSUE 11): the two durability numbers — async-save overhead
+# (wall of N checkpointed steps vs the same N without) and resume
+# time-to-first-step — committed as CKPTBENCH.json.  ckptbench-check
+# re-measures with bench-check's device-class guard (cross-class
+# comparisons pass with a loud re-capture note) and the exit-75 outage
+# contract when CKPTBENCH_PLATFORM targets a real accelerator; the band
+# is wide (CKPTBENCH_BAND, default 75%) because subprocess wall times on
+# small shared boxes are noise-dominated.
+ckptbench:
+	JAX_PLATFORMS=cpu python scripts/chaos.py --bench
+
+ckptbench-check:
+	JAX_PLATFORMS=cpu python scripts/chaos.py --bench --check
+
+# bench-check-style aggregate for everything chip-free: one target CI can
+# run without touching an accelerator (chaos-smoke DOES run a few real
+# CPU training subprocesses over generated synthetic data — budget the
+# job for minutes, not seconds).
+check-static: lint telemetry-smoke numerics-smoke chaos-smoke
+	@echo "check-static: lint engine + watchdog audit + HLO collective audit + telemetry smoke + numerics smoke + chaos smoke all green"
 
 # Static watchdog-coverage audit alone (ISSUE 3; now a shim over the lint
 # engine's watchdog-coverage rule — same CLI, same exit codes).  Also runs

@@ -322,15 +322,20 @@ def load_baseline(path: str) -> Counter:
 
 
 def write_baseline(path: str, findings: list[Finding]) -> None:
+    from batchai_retinanet_horovod_coco_tpu.utils.atomicio import (
+        atomic_write_text,
+    )
+
     entries = sorted(
         ({"rule": f.rule, "path": f.path, "snippet": f.snippet}
          for f in findings),
         key=lambda e: (e["path"], e["rule"], e["snippet"]),
     )
-    with open(path, "w") as f:
-        json.dump({"version": 1, "entries": entries}, f, indent=1,
-                  sort_keys=True)
-        f.write("\n")
+    atomic_write_text(
+        path,
+        json.dumps({"version": 1, "entries": entries}, indent=1,
+                   sort_keys=True) + "\n",
+    )
 
 
 # ---- whole-run driver ----------------------------------------------------

@@ -2,6 +2,7 @@
 engine registry (engine._ensure_rules_loaded does exactly that)."""
 
 from batchai_retinanet_horovod_coco_tpu.analysis.rules import (  # noqa: F401
+    atomic_artifacts,
     bounded_queues,
     collective_safety,
     jit_purity,

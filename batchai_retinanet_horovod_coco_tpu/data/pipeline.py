@@ -114,6 +114,20 @@ class PipelineConfig:
     mp_start_method: str = "spawn"
     prefetch: int = 4
     drop_remainder: bool = True
+    # Elastic resume (ISSUE 11): skip this many ALREADY-CONSUMED batches
+    # before emitting the first one (train only).  Batch composition is a
+    # pure function of (seed, epoch, shard) — ``batch_plans`` — so skipping
+    # k plans without decoding re-derives the exact stream position of a
+    # run that consumed k batches: no batch replayed, none skipped.  The
+    # train loop consumes one batch per process per step, so a resume at
+    # step r passes r here (train.py --resume-elastic).
+    skip_batches: int = 0
+    # Self-healing numerics resume (ISSUE 11): source image_ids that must
+    # never be emitted again — ``--auto-resume`` passes the poison batch's
+    # ids from NUMERICS_DUMP.json so the batch that tripped the abort
+    # cannot recur.  Applied after the epoch shuffle, before sharding, in
+    # ``epoch_indices`` (shared by the thread and shm producers).
+    exclude_ids: tuple[int, ...] = ()
     # Default: ship uint8 and normalize ON DEVICE (see normalize_images).
     # True restores the reference's host-side f32 preprocessing.
     host_normalize: bool = False
@@ -415,12 +429,28 @@ def example_rng(
 def epoch_indices(
     dataset, config: PipelineConfig, train: bool, epoch: int
 ) -> list[int]:
-    """This shard's record indices for ``epoch``, shuffled per (seed, epoch)."""
+    """This shard's record indices for ``epoch``, shuffled per (seed, epoch).
+
+    ``config.exclude_ids`` drops records AFTER the shuffle and before
+    sharding: the (seed, epoch) permutation is unchanged, the excluded
+    images simply leave holes — so the auto-resume exclusion perturbs the
+    stream minimally and deterministically on every shard.
+    """
     idx = np.arange(len(dataset.records))
     if train and config.shuffle:
         np.random.default_rng(
             np.random.SeedSequence([config.seed, epoch])
         ).shuffle(idx)
+    if config.exclude_ids:
+        excluded = {int(i) for i in config.exclude_ids}
+        idx = np.asarray(
+            [
+                i
+                for i in idx
+                if int(dataset.records[i].image_id) not in excluded
+            ],
+            dtype=np.int64,
+        )
     return list(idx[config.shard_index :: config.shard_count])
 
 
@@ -566,10 +596,18 @@ def build_pipeline(
                 return ok
 
             epoch = 0
+            # Elastic resume: already-consumed batches are skipped at the
+            # PLAN level — no decode, no RNG draw, just plan arithmetic —
+            # so fast-forwarding to step r costs milliseconds, not a
+            # replay of r batches of JPEG work.
+            to_skip = config.skip_batches if train else 0
             while not stop.is_set():
                 for bucket, chunk, ids, short in batch_plans(
                     dataset, config, train, epoch
                 ):
+                    if to_skip > 0:
+                        to_skip -= 1
+                        continue
                     futures = [
                         pool.submit(
                             load_example,

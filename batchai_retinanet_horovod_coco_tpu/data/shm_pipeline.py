@@ -541,10 +541,17 @@ class _ShmPipeline:
     def _produce(self) -> None:
         config, train = self._config, self._train
         epoch = 0
+        # Elastic-resume fast-forward: same plan-level skip as the thread
+        # producer (data/pipeline.py) — bit-identical streams require the
+        # two paths to skip identically.
+        to_skip = config.skip_batches if train else 0
         while not self._stop.is_set():
             for bucket, chunk, ids, short in batch_plans(
                 self._dataset, config, train, epoch
             ):
+                if to_skip > 0:
+                    to_skip -= 1
+                    continue
                 bucket_id = self._bucket_ids[bucket]
                 seqs = []
                 for i in chunk:

@@ -86,6 +86,11 @@ def make_synthetic_coco(
         ],
     }
     ann_path = os.path.join(root, f"instances_{split}.json")
-    with open(ann_path, "w") as f:
-        json.dump(blob, f)
+    # Atomic: concurrent pod workers regenerate the same dataset path, and
+    # a reader must never see a half-written annotations file.
+    from batchai_retinanet_horovod_coco_tpu.utils.atomicio import (
+        atomic_write_text,
+    )
+
+    atomic_write_text(ann_path, json.dumps(blob))
     return ann_path

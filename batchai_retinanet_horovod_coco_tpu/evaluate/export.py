@@ -33,6 +33,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from batchai_retinanet_horovod_coco_tpu.utils.atomicio import (
+    atomic_write_bytes,
+    atomic_write_text,
+)
 from batchai_retinanet_horovod_coco_tpu.evaluate.detect import (
     DetectConfig,
     make_detect_fn,
@@ -117,8 +121,9 @@ def export_model(
             data = export_detector(
                 state, model, hw, b, config, platforms=platforms
             )
-            with open(os.path.join(output_dir, name), "wb") as f:
-                f.write(data)
+            # Atomic: the manifest names this file; a torn artifact must
+            # never be loadable under its published name (ISSUE 11 rule).
+            atomic_write_bytes(os.path.join(output_dir, name), data)
             entries.append(
                 {"file": name, "height": hw[0], "width": hw[1],
                  "batch_size": b}
@@ -158,8 +163,10 @@ def export_model(
         ),
     }
     path = os.path.join(output_dir, _MANIFEST)
-    with open(path, "w") as f:
-        json.dump(manifest, f, indent=2)
+    # The manifest is the export's commit record (serve/engine.from_export
+    # trusts it): written atomically, and LAST — after every artifact it
+    # names exists on disk.
+    atomic_write_text(path, json.dumps(manifest, indent=2))
     return path
 
 

@@ -601,11 +601,37 @@ def _violations_section(
                 agg["description"] = v.get("description")
         for name, n in counts.items():
             rules[name]["count"] = max(rules[name]["count"], n)
-    return {
+    out = {
         "trace_markers": len(trace_v),
         "jsonl_events": len(jsonl_v),
         "rules": {k: rules[k] for k in sorted(rules)},
     }
+    # Self-healing resumes (ISSUE 11): an auto_resume is a survived
+    # incident, not a violation, but it belongs in the same read-back —
+    # a report whose run silently restarted mid-way must say so.  The
+    # key is present only when such events exist, so healthy-run reports
+    # (and the committed goldens) are byte-identical to schema v3.
+    if runs:
+        resumes = [
+            r
+            for r in runs[-1].get("records", [])
+            if r.get("event") == "auto_resume"
+        ]
+        if resumes:
+            out["auto_resumes"] = {
+                "count": len(resumes),
+                "restored_steps": [
+                    r.get("restored_step") for r in resumes
+                ],
+                "excluded_ids": sorted(
+                    {
+                        int(i)
+                        for r in resumes
+                        for i in (r.get("exclude_ids") or [])
+                    }
+                ),
+            }
+    return out
 
 
 def _series_stats(values: list[float]) -> dict | None:

@@ -400,6 +400,35 @@ def nonfinite_rule(metric: str = "train_nonfinite_total") -> SloRule:
     )
 
 
+def ckpt_staleness_rule(
+    factor: float = 2.0,
+    metric: str = "ckpt_staleness",
+    for_s: float = 0.0,
+) -> SloRule:
+    """Fires when training has ADVANCED ``factor ×`` the measured
+    steps-between-saves past the last successful checkpoint (ISSUE 11):
+    a silently wedged or crash-looping saver is otherwise invisible
+    until the run dies and resume discovers hours of lost work.  The
+    metric is the telemetry plane's STEP-based ``ckpt_staleness`` pull
+    gauge (obs/telemetry.py, present once two saves have landed) — not
+    the wall-clock age, which a legitimate multi-minute sync eval or
+    cold compile inflates while no step runs; steps only advance when
+    the loop is actually training past its save cadence."""
+    return SloRule(
+        name="ckpt-staleness",
+        metric=metric,
+        op=">",
+        threshold=factor,
+        for_s=for_s,
+        description=(
+            f"training advanced {factor}x the save cadence with no "
+            "checkpoint landing (saver wedged/dying; see "
+            "ckpt_write_error on stderr and the ckpt-writer watchdog "
+            "component)"
+        ),
+    )
+
+
 def grad_norm_spike(
     factor: float = 10.0,
     window: int = 32,

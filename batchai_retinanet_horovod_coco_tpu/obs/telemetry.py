@@ -500,6 +500,144 @@ def _process_collector() -> Iterator[CollectorSample]:
 
 
 # ---------------------------------------------------------------------------
+# Checkpoint health (ISSUE 11, utils/checkpoint.py)
+# ---------------------------------------------------------------------------
+
+# Push half: the checkpoint writer records each landed save (enable-gated,
+# one bool while off).  Pull half: staleness is computed at scrape time,
+# so a WEDGED saver — the failure this exists for — keeps degrading with
+# no further events; the built-in staleness SLO rule
+# (obs/slo.py::ckpt_staleness_rule) fires on the STEP-based
+# ckpt_staleness gauge > 2, i.e. training advanced 2x the measured
+# steps-between-saves with no checkpoint landing.  (The wall-clock
+# age gauges stay informational: a multi-minute sync eval inflates
+# them while no step runs.)
+_ckpt_lock = threading.Lock()
+_ckpt_state = {
+    "last_success_t": None,   # monotonic_s of the last landed save
+    "interval_s": None,       # gap between the last two landed saves
+    "last_save_s": None,      # write duration of the last landed save
+    "last_bytes": None,
+    "last_step": None,        # step of the last landed save
+    "interval_steps": None,   # steps between the last two landed saves
+    "saves_total": 0,
+    "inflight": 0,
+}
+
+
+def record_ckpt_save(step: int, save_s: float, total_bytes: int) -> None:
+    """The checkpoint writer's landed-save record site.  One bool check
+    while telemetry is off."""
+    if not _enabled:
+        return
+    now = monotonic_s()
+    with _ckpt_lock:
+        prev = _ckpt_state["last_success_t"]
+        if prev is not None:
+            _ckpt_state["interval_s"] = now - prev
+        prev_step = _ckpt_state["last_step"]
+        if prev_step is not None and step > prev_step:
+            _ckpt_state["interval_steps"] = int(step) - int(prev_step)
+        _ckpt_state["last_success_t"] = now
+        _ckpt_state["last_save_s"] = float(save_s)
+        _ckpt_state["last_bytes"] = float(total_bytes)
+        _ckpt_state["last_step"] = int(step)
+        _ckpt_state["saves_total"] += 1
+
+
+def record_ckpt_inflight(n: int) -> None:
+    """Writer-queue occupancy (0/1 under the one-behind contract)."""
+    if not _enabled:
+        return
+    with _ckpt_lock:
+        _ckpt_state["inflight"] = int(n)
+
+
+def _ckpt_collector() -> Iterator[CollectorSample]:
+    with _ckpt_lock:
+        s = dict(_ckpt_state)
+    if not s["saves_total"] and not s["inflight"]:
+        return  # no checkpointing in this process — no metric noise
+    yield (
+        "ckpt_saves_total", "counter",
+        "checkpoints successfully committed by this process", None,
+        float(s["saves_total"]),
+    )
+    yield (
+        "ckpt_inflight", "gauge",
+        "checkpoint writes currently in flight (0/1: one-behind)", None,
+        float(s["inflight"]),
+    )
+    if s["last_save_s"] is not None:
+        yield (
+            "ckpt_save_s", "gauge",
+            "write seconds of the last committed checkpoint", None,
+            round(s["last_save_s"], 4),
+        )
+    if s["last_step"] is not None:
+        yield (
+            "ckpt_last_step", "gauge",
+            "train step of the last committed checkpoint (what resume "
+            "would restore — the actionable half of a staleness page)",
+            None, float(s["last_step"]),
+        )
+    if s["last_bytes"] is not None:
+        yield (
+            "ckpt_bytes", "gauge",
+            "payload bytes of the last committed checkpoint", None,
+            s["last_bytes"],
+        )
+    if s["last_success_t"] is not None:
+        age = monotonic_s() - s["last_success_t"]
+        yield (
+            "ckpt_last_success_age_s", "gauge",
+            "seconds since the last successfully committed checkpoint "
+            "(informational: grows through legitimate pauses — evals, "
+            "compiles — too)", None, round(age, 3),
+        )
+        if s["interval_s"] is not None and s["interval_s"] > 0:
+            yield (
+                "ckpt_age_over_interval", "gauge",
+                "ckpt_last_success_age_s / measured save interval "
+                "(informational; a long sync eval inflates it — the "
+                "staleness SLO watches ckpt_staleness instead)", None,
+                round(age / s["interval_s"], 3),
+            )
+        # The SLO-grade signal: STEPS since the last save over the
+        # measured steps-between-saves.  Steps don't advance during
+        # evals/compiles, so a healthy pause can't inflate it — > 2
+        # genuinely means the loop is training past the save cadence
+        # without checkpoints landing (wedged/dying saver).
+        step_now = _current_train_step()
+        if (
+            step_now is not None
+            and s["interval_steps"]
+            and s["interval_steps"] > 0
+        ):
+            yield (
+                "ckpt_staleness", "gauge",
+                "(train_step - ckpt_last_step) / measured save interval "
+                "in steps (> 2 = saves stopped landing while training "
+                "advances; the built-in staleness SLO rule fires on it)",
+                None,
+                round(
+                    max(0.0, step_now - s["last_step"])
+                    / s["interval_steps"],
+                    3,
+                ),
+            )
+
+
+def _current_train_step() -> float | None:
+    """The train_step gauge's last pushed value, if the loop has
+    recorded one (scrape-time read; no lock beyond the gauge's own)."""
+    if _train_gauges is None:
+        return None
+    samples = _train_gauges["step"].samples()
+    return float(samples[0][1]) if samples else None
+
+
+# ---------------------------------------------------------------------------
 # The process-default registry + the train-loop record sites
 # ---------------------------------------------------------------------------
 
@@ -517,6 +655,7 @@ def default() -> Registry:
             r.register_collector(watchdog_collector())
             r.register_collector(device_memory_collector)
             r.register_collector(_process_collector)
+            r.register_collector(_ckpt_collector)
             _default = r
         return _default
 
@@ -528,6 +667,12 @@ def reset() -> None:
     with _default_lock:
         _default = None
     _train_gauges = None
+    with _ckpt_lock:
+        _ckpt_state.update(
+            last_success_t=None, interval_s=None, last_save_s=None,
+            last_bytes=None, last_step=None, interval_steps=None,
+            saves_total=0, inflight=0,
+        )
 
 
 # Lazily-created train metric handles on the default registry (the loop's

@@ -439,7 +439,16 @@ def merge_traces(
         except (OSError, ValueError, KeyError):
             skipped.append(name)
     out = os.path.join(trace_dir, out_name)
-    with open(out, "w") as f:
+    # Atomic like the per-process exports above: the perf doctor and
+    # Perfetto both scan for trace.json by name (utils.atomicio is
+    # jax-free — this module's import contract holds).  STREAMED into
+    # the tmp file: a long run's merged events are large, and a full
+    # json.dumps string would double peak memory at finalize.
+    from batchai_retinanet_horovod_coco_tpu.utils.atomicio import (
+        atomic_writer,
+    )
+
+    with atomic_writer(out) as f:
         json.dump(
             {
                 "traceEvents": events,

@@ -20,10 +20,20 @@ optimizer-state partitioning idea:
 Storage layout: every parameter leaf is flattened, zero-padded to a multiple
 of N, and its optimizer-state counterparts live as global ``(N * chunk,)``
 arrays sharded on the leading axis.  Scalar state (schedule counts, plateau
-controllers) stays replicated.  A sharded opt_state is tied to the mesh size
-that created it — resuming on a different device count needs the replicated
-mode (the reference had the same property: Horovod checkpoints assumed the
-same world size for optimizer slots).
+controllers) stays replicated.
+
+The pytree STRUCTURE of a sharded opt_state is identical to the replicated
+one (``tx.init`` over a params-like tree of shards), only the leaf shapes
+differ — and because the padding is zeros, converting between world sizes
+(or to/from the replicated layout) is pure shape surgery:
+``reshard_flat_leaf`` below truncates-or-zero-pads the flat representation
+to the target layout, refusing loudly if the truncated tail carries data.
+That is what makes checkpoints world-size-elastic (ISSUE 11,
+utils/checkpoint.py): a ZeRO checkpoint saved at world N restores at world
+M ≠ N — including M = 1, the replicated single-host recovery of a pod
+snapshot — which the reference could not do (Horovod checkpoints assumed
+the same world size for optimizer slots), and which the weight-update
+sharding paper (PAPERS.md) treats as the resharding problem.
 
 Gradient clipping: ``optax.clip_by_global_norm`` inside the chain would see
 only the local shard and compute a wrong norm, so the chain is built without
@@ -144,6 +154,56 @@ def clip_by_global_norm_sharded(
         return jax.tree.map(lambda g: g * scale, updates), state
 
     return optax.GradientTransformationExtraArgs(init_fn, update_fn)
+
+
+def reshard_flat_leaf(saved, shape, dtype, path: str = ""):
+    """Re-lay one optimizer-state leaf saved in one ZeRO/replicated layout
+    into another (host-side numpy; the checkpoint restore path).
+
+    The storage-format rule (``opt_state_partition_specs``) means a leaf is
+    either its logical parameter shape (replicated layout) or a flat
+    zero-padded ``(N * chunk,)`` array (world-N sharded layout), and the
+    padded flat form CONTAINS the logical content as a prefix with zeros
+    after it.  So any layout→layout conversion is: flatten, truncate or
+    zero-pad to the target element count, reshape — valid iff every
+    truncated element is zero (anything else means the checkpoint does not
+    actually hold this parameter's state: wrong model, wrong optimizer, or
+    corruption — refuse loudly rather than silently drop data).
+    """
+    import numpy as np
+
+    saved = np.asarray(saved)
+    shape = tuple(int(d) for d in shape)
+    if saved.dtype != np.dtype(dtype):
+        raise ValueError(
+            f"checkpoint leaf {path or '<leaf>'}: dtype "
+            f"{saved.dtype} != expected {np.dtype(dtype)}"
+        )
+    if saved.shape == shape:
+        return saved
+    if saved.ndim != 1 and len(shape) != 1:
+        # Neither side is a flat ZeRO layout — this is a genuine model/
+        # optimizer mismatch, not a resharding problem.
+        raise ValueError(
+            f"checkpoint leaf {path or '<leaf>'}: shape {saved.shape} != "
+            f"expected {shape} and neither is a flat ZeRO layout"
+        )
+    flat = saved.reshape(-1)
+    target = 1
+    for d in shape:
+        target *= d
+    if flat.size > target:
+        if np.count_nonzero(flat[target:]):
+            raise ValueError(
+                f"checkpoint leaf {path or '<leaf>'}: truncating "
+                f"{flat.size} -> {target} elements would drop non-zero "
+                "state (not ZeRO padding) — the checkpoint does not match "
+                "this model/optimizer"
+            )
+        flat = flat[:target]
+    elif flat.size < target:
+        flat = np.pad(flat, (0, target - flat.size))
+    return np.ascontiguousarray(flat.reshape(shape))
 
 
 def init_sharded_opt_state(

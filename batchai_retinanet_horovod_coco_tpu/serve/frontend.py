@@ -653,7 +653,14 @@ def main(argv: list[str] | None = None) -> dict:
             while drained < len(futures):
                 drain_one()
             if args.output:
-                with open(args.output, "w") as f:
+                # Atomic: downstream tooling ingests this JSONL by name;
+                # publish it complete or not at all — streamed, so a big
+                # offline batch never materializes twice in memory.
+                from batchai_retinanet_horovod_coco_tpu.utils.atomicio import (
+                    atomic_writer,
+                )
+
+                with atomic_writer(args.output) as f:
                     for rec in records:
                         f.write(json.dumps(rec) + "\n")
                 print(f"wrote {len(records)} records to {args.output}")

@@ -194,6 +194,11 @@ class LoopConfig:
     # Recorded in the provenance dump (reproduction context); train.py
     # passes --seed through.
     rng_seed: int | None = None
+    # Recorded verbatim in every checkpoint manifest (utils/checkpoint.py).
+    # train.py stores the data-order facts --resume-elastic re-derives the
+    # stream position from (global batch size, data seed); anything a
+    # future resume needs to validate against belongs here.
+    ckpt_metadata: dict | None = None
 
 
 def _device_batch(batch: Batch, mesh: Mesh | None) -> dict[str, Any]:
@@ -483,42 +488,53 @@ def run_training(
             config.checkpoint_dir,
             max_to_keep=config.max_to_keep,
             save_interval_steps=config.checkpoint_every,
+            metadata=config.ckpt_metadata,
+            sink=logger,
         )
         if config.resume and ckpt.latest_step() is not None:
+            t_restore = monotonic_s()
             try:
-                state = ckpt.restore(state)
+                with trace.span("ckpt_restore"):
+                    state = ckpt.restore(state)
             except Exception as e:
                 raise RuntimeError(
                     f"restoring {config.checkpoint_dir} failed (root cause "
-                    "in the chained traceback). If the shapes/tree mismatch: "
-                    "a --shard-weight-update checkpoint cannot resume in "
-                    "replicated mode or on a different device count, and "
-                    "vice versa — the optimizer-state layouts differ "
-                    "(parallel/zero.py); re-run with the original mode/"
-                    "topology. Otherwise the checkpoint may be incomplete "
-                    "or corrupt — start fresh with --no-resume."
+                    "in the chained traceback). Optimizer-state layouts "
+                    "reshard automatically across world sizes and between "
+                    "--shard-weight-update and replicated mode "
+                    "(utils/checkpoint.py), so a shape mismatch here means "
+                    "a DIFFERENT model/optimizer was checkpointed; "
+                    "otherwise every checkpoint in the directory is torn — "
+                    "see ckpt_torn_skipped on stderr, or start fresh with "
+                    "--no-resume."
                 ) from e
             print(f"resumed from step {int(state.step)}", flush=True)
-            if jax.process_count() > 1:
-                # Restored arrays are COMMITTED to this process's devices; a
-                # device_put onto the global mesh from committed arrays would
-                # need cross-host transfers (unsupported on some backends).
-                # Every process restored identical values, so pull to host
-                # and let the replication below proceed host-locally.
-                if shard_weight_update:
-                    # The sharded optimizer state was restored by orbax
-                    # directly into its global 1/N layout (the restore
-                    # template carries the sharding); its shards are
-                    # non-addressable cross-host, so it must NOT be pulled
-                    # — and need not be: it is already where the step wants
-                    # it.  Only the replicated leaves round-trip.
-                    state = state.replace(
-                        step=jax.device_get(state.step),
-                        params=jax.device_get(state.params),
-                        batch_stats=jax.device_get(state.batch_stats),
-                    )
-                else:
-                    state = jax.device_get(state)
+            restore_s = monotonic_s() - t_restore
+            log_event = getattr(logger, "event", None)
+            if log_event is not None:
+                log_event(
+                    "ckpt_restored",
+                    step=int(state.step),
+                    restore_s=round(restore_s, 4),
+                )
+            if jax.process_count() == 1:
+                # Restored leaves are HOST numpy.  Materialize jax-OWNED
+                # device buffers via a compiled copy (jnp.copy), never a
+                # bare device_put: XLA:CPU's device_put is ZERO-COPY for
+                # numpy inputs, the train step DONATES its input state,
+                # and donating a numpy-aliased buffer hands numpy-owned
+                # memory to XLA's allocator — observed as glibc heap
+                # corruption ("corrupted double-linked list") at the
+                # first post-resume step.  The mesh replication below
+                # then proceeds from committed device arrays, exactly as
+                # it always has.  Multi-host keeps host numpy: every
+                # process restored identical values and the replication
+                # block's global device_put wants process-local host
+                # data (TPU puts always copy; the alias hazard is
+                # CPU-backend-only).
+                import jax.numpy as jnp
+
+                state = jax.tree.map(jnp.copy, state)
 
     if mesh is not None:
         # Replicate state over the mesh (restored arrays land committed to a
@@ -868,6 +884,23 @@ def run_training(
         # failures DO raise.
         if eval_runner is not None:
             eval_runner.finalize_on_error()
+        if ckpt is not None:
+            # Quiesce the async writer BEFORE the exception escapes: an
+            # --auto-resume caller re-enters with a NEW manager on the
+            # same directory, and an abandoned in-flight write racing it
+            # could gc the new writer's tmp dir or publish a pre-abort
+            # state after the heal chose its restore point.  close()
+            # joins the in-flight save (a healthy, pre-abort checkpoint
+            # — letting it land is exactly right); its own failure is
+            # warned, never raised — it must not mask the original
+            # exception.
+            try:
+                ckpt.close()
+            except Exception as ckpt_exc:
+                warnings.warn(
+                    "checkpoint writer close failed during loop unwind: "
+                    f"{ckpt_exc!r}"
+                )
         raise
     finally:
         # Stop the prefetch thread deterministically (even when the

@@ -355,9 +355,14 @@ def main(argv: list[str] | None = None) -> int:
                 "winner": doc["entries"]["nms"],
                 "schedule_artifact": os.path.relpath(path, _repo_root()),
             }
-            with open(bench_out, "w") as f:
-                json.dump(record, f, indent=2, sort_keys=True)
-                f.write("\n")
+            from batchai_retinanet_horovod_coco_tpu.utils.atomicio import (
+                atomic_write_text,
+            )
+
+            atomic_write_text(
+                bench_out,
+                json.dumps(record, indent=2, sort_keys=True) + "\n",
+            )
             print(f"# tunebench record written to {bench_out}")
         return 0
     except SystemExit:

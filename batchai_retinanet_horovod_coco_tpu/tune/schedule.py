@@ -167,13 +167,19 @@ def schedule_path(device_kind: str, root: str | None = None) -> str:
 
 
 def save_schedule(doc: dict, root: str | None = None) -> str:
-    """Validate + write one device's schedule artifact; returns the path."""
+    """Validate + write one device's schedule artifact; returns the path.
+    Atomic: every train/eval/serve/export bring-up resolves this file by
+    path — a torn registry must be unobservable."""
+    from batchai_retinanet_horovod_coco_tpu.utils.atomicio import (
+        atomic_write_text,
+    )
+
     validate_schedule(doc)
     path = schedule_path(doc["device_kind"], root)
     os.makedirs(os.path.dirname(path), exist_ok=True)
-    with open(path, "w") as f:
-        json.dump(doc, f, indent=2, sort_keys=True)
-        f.write("\n")
+    atomic_write_text(
+        path, json.dumps(doc, indent=2, sort_keys=True) + "\n"
+    )
     _cache_clear()
     return path
 

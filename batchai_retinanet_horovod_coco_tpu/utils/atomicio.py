@@ -1,0 +1,113 @@
+"""Atomic artifact writes: tmp-then-rename, fsync'd (ISSUE 11).
+
+Any file a restore/resume/consumer path SCANS — checkpoint manifests,
+export manifests, schedule registries, lint baselines, trace exports —
+must never be observable half-written: a reader racing a plain
+``open(path, "w")`` (or a process killed mid-write) sees a truncated
+file and either crashes or, worse, silently loads garbage.  The
+protocol here is the standard one the checkpoint subsystem is built on
+(utils/checkpoint.py):
+
+1. write the full payload to ``<path>.tmp-<pid>`` in the SAME directory
+   (``os.replace`` is only atomic within one filesystem),
+2. flush + ``os.fsync`` the file so the bytes are durable before the
+   name is,
+3. ``os.replace`` onto the final name (atomic on POSIX),
+4. optionally fsync the parent directory so the rename itself survives
+   a power cut (``fsync_dir`` — the checkpoint writer does this; most
+   artifact writers accept the tiny window).
+
+The ``atomic-artifacts`` lint rule (analysis/rules/atomic_artifacts.py)
+enforces the pattern package-wide: a write-mode ``open`` in a function
+with no rename is a finding unless it goes through these helpers.
+
+stdlib-only — importable from jax-free processes (shm decode workers,
+the analysis package, obs.trace).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+from typing import Any, Iterator
+
+
+def _tmp_path(path: str) -> str:
+    head, tail = os.path.split(path)
+    return os.path.join(head, f".{tail}.tmp-{os.getpid()}")
+
+
+def atomic_write_bytes(path: str, data: bytes, fsync: bool = True) -> None:
+    """Write ``data`` to ``path`` atomically (tmp + fsync + rename)."""
+    tmp = _tmp_path(path)
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        # Never leave a stray tmp behind a failed write (readers ignore
+        # dotfiles, but a crash loop would accumulate them).
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write_text(path: str, text: str, fsync: bool = True) -> None:
+    atomic_write_bytes(path, text.encode("utf-8"), fsync=fsync)
+
+
+def atomic_write_json(
+    path: str, obj: Any, fsync: bool = True, **json_kwargs: Any
+) -> None:
+    """``json.dump`` with the atomic protocol (the manifest idiom)."""
+    atomic_write_text(path, json.dumps(obj, **json_kwargs), fsync=fsync)
+
+
+@contextlib.contextmanager
+def atomic_writer(
+    path: str, mode: str = "w", fsync: bool = True
+) -> Iterator[Any]:
+    """STREAMING atomic write: yields the tmp file object, commits via
+    rename on clean exit, unlinks on error.  For payloads too large to
+    materialize as one string/bytes (a merged multi-process trace, a
+    long results JSONL) — ``json.dump(doc, f)`` straight into the tmp
+    file keeps peak memory at the document, not document + serialization.
+    """
+    tmp = _tmp_path(path)
+    try:
+        with open(tmp, mode) as f:
+            yield f
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY so a completed rename inside it is durable.
+
+    Best-effort: some filesystems/platforms refuse O_DIRECTORY fsync;
+    the rename is still atomic, only its durability window widens.
+    """
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)

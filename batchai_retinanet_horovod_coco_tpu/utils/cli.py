@@ -147,6 +147,42 @@ def add_obs_flags(parser) -> None:
                              "with or without this flag")
 
 
+def add_durability_flags(parser) -> None:
+    """The preemption/recovery flag surface (ISSUE 11, train.py).  One
+    definition so the chaos harness (scripts/chaos.py) and any future
+    tool that grows resume semantics expose identical knobs."""
+    parser.add_argument("--resume-elastic", action="store_true",
+                        help="on resume, re-derive the input-stream "
+                             "position from the checkpoint manifest "
+                             "(consumed batches = restored step) so no "
+                             "batch is replayed or skipped — including "
+                             "when the world size changed since the save "
+                             "(the ZeRO optimizer state reshards "
+                             "automatically; utils/checkpoint.py).  "
+                             "Requires the same --batch-size and --seed "
+                             "the checkpoint was written with (validated "
+                             "against the manifest)")
+    parser.add_argument("--auto-resume", action="store_true",
+                        help="self-healing numerics resume: on a "
+                             "non-finite abort, restore the last healthy "
+                             "checkpoint (the pre-save gate guarantees "
+                             "finiteness), reseed the data order and "
+                             "exclude the poison batch's image ids "
+                             "recorded in NUMERICS_DUMP.json, emit one "
+                             "structured auto_resume event, and continue "
+                             "to --steps")
+    parser.add_argument("--max-auto-resumes", type=int, default=3,
+                        help="give up (re-raise the abort) after this "
+                             "many auto-resumes in one invocation")
+    parser.add_argument("--inject-nan-step", type=int, default=None,
+                        metavar="N",
+                        help="FAULT INJECTION (scripts/chaos.py): poison "
+                             "the N-th training batch with NaN, once per "
+                             "process — exercises the numerics abort + "
+                             "--auto-resume path end-to-end on a real "
+                             "run.  Never use outside chaos testing")
+
+
 def add_serve_flags(parser) -> None:
     """The inference-server flag surface (serve/frontend.py CLI and
     ``bench.py --mode serve``; ISSUE 4).  One definition so the bench's

@@ -56,12 +56,13 @@ jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 # worker ranks (test_pod_launch / test_fault_injection) each set their own
 # per-test cache dir explicitly.
 
-# Synchronous checkpointing under test: orbax's ASYNC finalize thread
-# (cross-thread asyncio wakeups) segfaults under this container's sandboxed
-# kernel when saves land back-to-back (checkpoint_every=1 tests), killing
-# the whole pytest session.  Production keeps the async default; see
-# utils/checkpoint.py.  Subprocess pod workers inherit this too.
-os.environ.setdefault("RETINANET_ASYNC_CKPT", "0")
+# Checkpointing runs ASYNC under test, like production: the native
+# writer (utils/checkpoint.py, ISSUE 11) is plain stdlib threading, so
+# the orbax async-finalize segfault class (cross-thread asyncio wakeups
+# + grpc under this container's sandboxed kernel) that once forced
+# RETINANET_ASYNC_CKPT=0 here is gone.  The env var survives as an
+# escape hatch selecting the synchronous path; tests that want it set it
+# explicitly.
 
 import jax.numpy as jnp  # noqa: E402
 import optax  # noqa: E402

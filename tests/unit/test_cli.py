@@ -424,3 +424,93 @@ class TestEndToEnd:
             r["step"] for r in records
             if any(k.startswith("eval/") for k in r)
         ) == [2, 4]
+
+
+class TestDurabilityFlags:
+    """ISSUE 11: the preemption/recovery surface parses and the resume
+    helpers derive the right plan from a manifest/dump."""
+
+    def test_flags_parse_with_defaults(self):
+        args = parse_args(["synthetic"])
+        assert args.resume_elastic is False
+        assert args.auto_resume is False
+        assert args.max_auto_resumes == 3
+        assert args.inject_nan_step is None
+        args = parse_args(
+            ["synthetic", "--resume-elastic", "--auto-resume",
+             "--max-auto-resumes", "1", "--inject-nan-step", "7"]
+        )
+        assert args.resume_elastic and args.auto_resume
+        assert (args.max_auto_resumes, args.inject_nan_step) == (1, 7)
+
+    def test_elastic_skip_validates_manifest(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+        import optax
+        import pytest
+
+        from batchai_retinanet_horovod_coco_tpu.train.state import TrainState
+        from batchai_retinanet_horovod_coco_tpu.utils.checkpoint import (
+            CheckpointManager,
+        )
+        from train import _elastic_skip_batches
+
+        state = TrainState(
+            step=jnp.asarray(40, jnp.int32),
+            params={"w": jnp.ones((3,), jnp.float32)},
+            batch_stats={}, opt_state=(), tx=optax.sgd(1e-2),
+        )
+        mgr = CheckpointManager(
+            str(tmp_path), metadata={"global_batch_size": 16, "data_seed": 0}
+        )
+        mgr.save(state, step=40, force=True)
+        mgr.close()
+
+        args = parse_args(
+            ["synthetic", "--resume-elastic", "--batch-size", "16",
+             "--snapshot-path", str(tmp_path)]
+        )
+        plan = _elastic_skip_batches(args)
+        assert plan["skip"] == 40
+        assert plan["data_seed"] == 0
+        assert plan["stream_base_step"] == 0
+        # Changed global batch -> the position is meaningless: abort.
+        args = parse_args(
+            ["synthetic", "--resume-elastic", "--batch-size", "8",
+             "--snapshot-path", str(tmp_path)]
+        )
+        with pytest.raises(SystemExit, match="global_batch_size"):
+            _elastic_skip_batches(args)
+
+    def test_auto_resume_plan_reads_poison_ids(self, tmp_path):
+        import json
+
+        from train import _auto_resume_plan
+
+        ckpt = tmp_path / "ckpt"
+        (ckpt / "ckpt-6").mkdir(parents=True)
+        (ckpt / "ckpt-6" / "manifest.json").write_text(
+            json.dumps({"format": "retinanet-ckpt", "version": 1,
+                        "step": 6, "leaves": []})
+        )
+        (tmp_path / "logs").mkdir()
+        (tmp_path / "logs" / "NUMERICS_DUMP.json").write_text(
+            json.dumps({"batch_image_ids": [700, 701]})
+        )
+        args = parse_args(
+            ["synthetic", "--auto-resume", "--seed", "5",
+             "--snapshot-path", str(ckpt),
+             "--log-dir", str(tmp_path / "logs")]
+        )
+        plan = _auto_resume_plan(args, 1, FloatingPointError("nan"))
+        assert plan["restored_step"] == 6
+        assert plan["exclude_ids"] == [700, 701]
+        assert plan["data_seed"] == 5 + 7919
+        # Attempt budget exhausted -> None (caller re-raises).
+        assert _auto_resume_plan(args, 99, FloatingPointError("nan")) is None
+        # No flag -> None.
+        args = parse_args(
+            ["synthetic", "--snapshot-path", str(ckpt),
+             "--log-dir", str(tmp_path / "logs")]
+        )
+        assert _auto_resume_plan(args, 1, FloatingPointError("nan")) is None

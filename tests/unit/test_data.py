@@ -296,3 +296,69 @@ def test_max_gt_truncation_is_counted_and_warned(tmp_path, caplog):
     assert batches.stats.truncated_boxes == 50
     assert batches.stats.truncated_images == 1
     assert any("truncates" in r.message for r in caplog.records)
+
+
+def _drain(it, n):
+    out = [next(it) for _ in range(n)]
+    it.close()
+    return out
+
+
+def test_skip_batches_fast_forwards_exactly(synthetic_dataset):
+    """ISSUE 11 elastic resume: skip_batches=k emits exactly the batches
+    a fresh pipeline emits from position k on — across epoch boundaries
+    (10 images / batch 2 = 5 plans per epoch; k=7 lands in epoch 2),
+    with augmentation bit-identical (per-example RNG is positional)."""
+    cfg = dict(
+        batch_size=2, buckets=((320, 320),), min_side=300, max_side=320,
+        max_gt=8, num_workers=2, seed=7,
+    )
+    full = _drain(
+        build_pipeline(synthetic_dataset, PipelineConfig(**cfg), train=True),
+        10,
+    )
+    skipped = _drain(
+        build_pipeline(
+            synthetic_dataset,
+            PipelineConfig(skip_batches=7, **cfg),
+            train=True,
+        ),
+        3,
+    )
+    for want, got in zip(full[7:], skipped):
+        np.testing.assert_array_equal(want.image_ids, got.image_ids)
+        np.testing.assert_array_equal(want.images, got.images)
+        np.testing.assert_array_equal(want.gt_boxes, got.gt_boxes)
+
+
+def test_exclude_ids_never_emitted_and_order_stable(synthetic_dataset):
+    """ISSUE 11 auto-resume: excluded image_ids never appear again, and
+    the surviving stream keeps the (seed, epoch) permutation ORDER of the
+    unfiltered one (exclusion leaves holes, it does not reshuffle)."""
+    cfg = dict(
+        batch_size=2, buckets=((320, 320),), min_side=300, max_side=320,
+        max_gt=8, num_workers=2, seed=7,
+    )
+    poison = tuple(
+        int(r.image_id) for r in synthetic_dataset.records[:2]
+    )
+    full = _drain(
+        build_pipeline(synthetic_dataset, PipelineConfig(**cfg), train=True),
+        5,
+    )
+    filtered = _drain(
+        build_pipeline(
+            synthetic_dataset,
+            PipelineConfig(exclude_ids=poison, **cfg),
+            train=True,
+        ),
+        4,  # one epoch = 8 survivors / batch 2
+    )
+    seen = [int(i) for b in filtered for i in b.image_ids]
+    assert not set(seen) & set(poison)
+    full_order = [
+        int(i) for b in full for i in b.image_ids if int(i) not in poison
+    ]
+    # Batch composition groups by bucket; within this single-bucket config
+    # the survivor order must match the unfiltered order exactly.
+    assert seen == full_order[: len(seen)]

@@ -662,3 +662,94 @@ class TestAnalyzeViolations:
         assert report["violations"] == {
             "trace_markers": 0, "jsonl_events": 0, "rules": {},
         }
+
+
+class TestCkptTelemetry:
+    """ISSUE 11 satellite: checkpoint health on the telemetry plane —
+    the record sites, the pull collector's age arithmetic, and the
+    built-in staleness SLO rule that makes a silently wedged saver
+    visible before the run dies."""
+
+    def setup_method(self):
+        telemetry.reset()
+        telemetry.enable()
+
+    def teardown_method(self):
+        telemetry.reset()
+
+    def test_record_sites_feed_the_collector(self):
+        telemetry.record_ckpt_inflight(1)
+        telemetry.record_ckpt_save(step=2, save_s=0.12, total_bytes=1000)
+        telemetry.record_ckpt_save(step=4, save_s=0.34, total_bytes=1000)
+        snap = telemetry.default().snapshot()
+        assert snap["ckpt_saves_total"] == 2
+        assert snap["ckpt_save_s"] == pytest.approx(0.34)
+        assert snap["ckpt_bytes"] == 1000
+        assert snap["ckpt_last_success_age_s"] >= 0
+        # Two saves landed -> a measured interval -> the ratio exists.
+        assert "ckpt_age_over_interval" in snap
+        assert snap["ckpt_inflight"] == 1
+        telemetry.record_ckpt_inflight(0)
+        assert telemetry.default().snapshot()["ckpt_inflight"] == 0
+
+    def test_no_checkpointing_no_metric_noise(self):
+        snap = telemetry.default().snapshot()
+        assert not any(k.startswith("ckpt_") for k in snap)
+
+    def test_disabled_record_sites_are_noops(self):
+        telemetry.disable()
+        telemetry.record_ckpt_save(step=2, save_s=0.1, total_bytes=10)
+        telemetry.record_ckpt_inflight(1)
+        telemetry.enable()
+        snap = telemetry.default().snapshot()
+        assert not any(k.startswith("ckpt_") for k in snap)
+
+    def test_staleness_rule_fires_once_when_saver_wedges(self):
+        # Saves landed at steps 2 and 4 (measured cadence: 2 steps).
+        telemetry.record_ckpt_save(step=2, save_s=0.1, total_bytes=10)
+        telemetry.record_ckpt_save(step=4, save_s=0.1, total_bytes=10)
+        mon = slo.SloMonitor(
+            telemetry.default(), [slo.ckpt_staleness_rule()]
+        )
+        # Healthy: training at step 5, one step past the save -> 0.5.
+        telemetry.record_train_window(
+            step=5, images_per_s=1.0, step_time_ms=1.0, data_wait_ms=0.0
+        )
+        snap = telemetry.default().snapshot()
+        assert snap["ckpt_staleness"] == pytest.approx(0.5)
+        assert mon.check_once(now=1.0) == []
+        # Wedged saver: training advanced 10 steps (5x the cadence) with
+        # no save landing.  STEP-based, so a long eval (steps frozen)
+        # could never have tripped this.
+        telemetry.record_train_window(
+            step=14, images_per_s=1.0, step_time_ms=1.0, data_wait_ms=0.0
+        )
+        fired = mon.check_once(now=2.0)
+        assert [v["rule"] for v in fired] == ["ckpt-staleness"]
+        assert mon.check_once(now=3.0) == []  # latched, no flapping
+
+    def test_manager_save_lands_on_the_plane(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from batchai_retinanet_horovod_coco_tpu.train.state import TrainState
+        from batchai_retinanet_horovod_coco_tpu.utils.checkpoint import (
+            CheckpointManager,
+        )
+
+        state = TrainState(
+            step=jnp.asarray(1, jnp.int32),
+            params={"w": jnp.ones((4,), jnp.float32)},
+            batch_stats={},
+            opt_state=(),
+            tx=optax.sgd(1e-2),
+        )
+        mgr = CheckpointManager(str(tmp_path / "ckpt"))
+        mgr.save(state, step=1, force=True)
+        mgr.wait()
+        mgr.close()
+        snap = telemetry.default().snapshot()
+        assert snap["ckpt_saves_total"] == 1
+        assert snap["ckpt_inflight"] == 0
+        assert snap["ckpt_save_s"] >= 0

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import json
 import os
 import sys
 
@@ -68,6 +69,7 @@ from batchai_retinanet_horovod_coco_tpu.models.retinanet import (  # noqa: E402
 from batchai_retinanet_horovod_coco_tpu.utils.cli import (  # noqa: E402
     add_anchor_flags,
     add_data_pipeline_flags,
+    add_durability_flags,
     add_obs_flags,
     configure_obs,
     make_anchor_config,
@@ -207,6 +209,10 @@ def build_parser() -> argparse.ArgumentParser:
                        help="checkpoint directory (enables checkpointing)")
         g.add_argument("--checkpoint-every", type=int, default=1000)
         g.add_argument("--no-resume", action="store_true")
+        # --resume-elastic / --auto-resume / --max-auto-resumes /
+        # --inject-nan-step: preemption & recovery surface (ISSUE 11,
+        # utils/cli.py — shared with scripts/chaos.py).
+        add_durability_flags(g)
         g.add_argument("--eval-every", type=int, default=0)
         g.add_argument("--async-eval", action="store_true",
                        help="run the mid-training eval hook in a background "
@@ -447,12 +453,190 @@ def _start_telemetry(args, logger):
         # regression rule (rolling-median baseline; silent until the
         # train_grad_norm gauge exists, so serve/eval runs are
         # unaffected).  User --slo-rule specs append after.
-        [slo.stall_rule(), slo.nonfinite_rule(), slo.grad_norm_spike()]
+        [slo.stall_rule(), slo.nonfinite_rule(), slo.grad_norm_spike(),
+         # Checkpoint staleness (ISSUE 11): silent until two saves have
+         # landed (the age/interval gauge needs a measured cadence), so
+         # un-checkpointed runs never see it evaluate.
+         slo.ckpt_staleness_rule()]
         + [slo.parse_rule(s) for s in rule_specs],
         sink=logger,
         poll_interval=getattr(args, "slo_poll_s", 5.0),
     ).start()
     return server, monitor
+
+
+def _elastic_skip_batches(args) -> dict:
+    """--resume-elastic: the stream plan that continues exactly where the
+    checkpointed run stopped — ``{"skip", "data_seed", "exclude_ids"}``.
+
+    The loop consumes ONE batch per process per step at every world size
+    (the global batch is split over processes), so the position within a
+    stream is ``step - stream_base_step`` (base 0 for a virgin run; an
+    --auto-resume heal RESTARTS the stream at its restore step with a new
+    seed and exclusions, and records all three in the manifest so this
+    derivation survives the heal).  The global batch size must match the
+    manifest (validated; a change makes the position meaningless, so it
+    aborts loudly), and so must --seed for a virgin stream; for a healed
+    stream the manifest's effective seed/exclusions WIN — they are the
+    order that was actually consumed.  At the same world size the
+    continuation is sample-exact (chaos-pinned bit-identical losses);
+    across a world-size change the per-shard record partition differs, so
+    it is position-exact and distribution-equivalent (PARITY.md).
+    """
+    from batchai_retinanet_horovod_coco_tpu.utils.checkpoint import (
+        read_manifest,
+    )
+
+    plan = {
+        "skip": 0,
+        "data_seed": int(args.seed),
+        "exclude_ids": (),
+        "stream_base_step": 0,
+    }
+    manifest = read_manifest(args.snapshot_path)
+    if manifest is None:
+        return plan
+    meta = manifest.get("metadata") or {}
+    base = int(meta.get("stream_base_step") or 0)
+    saved_gb = meta.get("global_batch_size")
+    if saved_gb is not None and int(saved_gb) != int(args.batch_size):
+        raise SystemExit(
+            f"--resume-elastic: global_batch_size changed since the "
+            f"checkpoint was written ({saved_gb} -> {args.batch_size}); "
+            "the stream position is only re-derivable at the batch size "
+            "the manifest recorded.  Re-run with the original value, or "
+            "drop --resume-elastic to resume with a restarted stream."
+        )
+    saved_seed = meta.get("data_seed")
+    if base == 0 and saved_seed is not None and int(saved_seed) != int(
+        args.seed
+    ):
+        raise SystemExit(
+            f"--resume-elastic: data_seed changed since the checkpoint "
+            f"was written ({saved_seed} -> {args.seed}); the stream "
+            "position is only re-derivable with the data order the "
+            "manifest recorded.  Re-run with the original value, or drop "
+            "--resume-elastic to resume with a restarted stream."
+        )
+    if saved_seed is not None:
+        plan["data_seed"] = int(saved_seed)  # healed stream: manifest wins
+    plan["exclude_ids"] = tuple(
+        int(i) for i in (meta.get("exclude_ids") or [])
+    )
+    plan["stream_base_step"] = base
+    plan["skip"] = max(0, int(manifest.get("step") or 0) - base)
+    if plan["skip"] or base:
+        print(
+            json.dumps(
+                {
+                    "event": "elastic_resume",
+                    "restored_step": int(manifest.get("step") or 0),
+                    "skip_batches_per_process": plan["skip"],
+                    "stream_base_step": base,
+                    "data_seed": plan["data_seed"],
+                    "excluded": len(plan["exclude_ids"]),
+                    "saved_world": meta.get("shard_count"),
+                    "zero_world_size": manifest.get("zero_world_size"),
+                }
+            ),
+            flush=True,
+        )
+    return plan
+
+
+def _read_poison_ids(dump_dir: str | None) -> list[int]:
+    """The tripped batch's source image ids from NUMERICS_DUMP.json (the
+    numerics abort wrote it just before raising); [] when unavailable."""
+    if not dump_dir:
+        return []
+    path = os.path.join(dump_dir, "NUMERICS_DUMP.json")
+    try:
+        with open(path) as f:
+            dump = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return []
+    try:
+        return [int(i) for i in (dump.get("batch_image_ids") or [])]
+    except (TypeError, ValueError):
+        return []
+
+
+def _auto_resume_plan(args, attempt: int, exc: BaseException) -> dict | None:
+    """Decide whether a numerics abort is self-healable (--auto-resume)
+    and with what; None = re-raise.  Requires a restorable checkpoint
+    (guaranteed finite by the loop's pre-save gate) and a remaining
+    attempt budget; the plan reseeds the data order and carries the
+    poison batch's image ids for exclusion."""
+    if not getattr(args, "auto_resume", False):
+        return None
+    if attempt > getattr(args, "max_auto_resumes", 3):
+        return None
+    if not args.snapshot_path or args.no_resume:
+        return None
+    from batchai_retinanet_horovod_coco_tpu.utils.checkpoint import (
+        latest_step as ckpt_latest_step,
+    )
+
+    restored = ckpt_latest_step(args.snapshot_path)
+    if restored is None:
+        return None  # nothing healthy on disk — the abort stands
+    dump_dir = getattr(args, "obs_dir", None) or args.log_dir
+    return {
+        "attempt": attempt,
+        "restored_step": int(restored),
+        # A deterministic reseed: the new (seed, epoch) permutation makes
+        # the post-resume order disjoint from the aborted one, and the
+        # exclusion below guarantees the poison batch cannot recur even
+        # if an image repeats.
+        "data_seed": int(args.seed) + 7919 * attempt,
+        "exclude_ids": _read_poison_ids(dump_dir),
+        "error": str(exc)[:300],
+    }
+
+
+class _NanInjector:
+    """--inject-nan-step fault hook (scripts/chaos.py): poison the N-th
+    consumed batch, exactly once per PROCESS — ``latch`` is shared across
+    auto-resume attempts so the fault cannot re-fire on the healed
+    stream.  The NaN goes into the IMAGE tensor (the uint8 production
+    batch is lifted to float32 first — normalize_images passes float
+    through — because uint8 cannot carry a NaN, and poisoning gt boxes
+    does NOT trip the sanitizer: NaN IoU comparisons are all False, so
+    matching classifies the poisoned anchors as 'ignore' and the NaN
+    never reaches the loss)."""
+
+    def __init__(self, inner, at_batch: int, latch: dict):
+        self._inner = inner
+        self._at = int(at_batch)
+        self._latch = latch
+        self._count = 0
+
+    @property
+    def stats(self):
+        return getattr(self._inner, "stats", None)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        batch = next(self._inner)
+        self._count += 1
+        if not self._latch["done"] and self._count == self._at:
+            self._latch["done"] = True
+            images = batch.images.astype(np.float32, copy=True)
+            images[0, 0, 0, 0] = np.nan
+            batch = batch._replace(images=images)
+            print(
+                json.dumps(
+                    {
+                        "event": "chaos_nan_injected",
+                        "batch": self._count,
+                        "image_ids": [int(i) for i in batch.image_ids],
+                    }
+                ),
+                file=sys.stderr, flush=True,
+            )
+        return batch
 
 
 def _run(args) -> dict[str, float]:
@@ -691,39 +875,49 @@ def _run(args) -> dict[str, float]:
     )
     buckets = default_buckets(args.image_min_side, args.image_max_side)
     init_hw = buckets[0]
-    state = create_train_state(
-        model, tx, (1, *init_hw, 3), jax.random.key(args.seed),
-        init_opt_state=not shard_update,
-    )
-    if shard_update:
-        from batchai_retinanet_horovod_coco_tpu.parallel import (
-            init_sharded_opt_state,
-            replicated_sharding,
-        )
 
-        # Replicate params over the GLOBAL mesh first: on multi-host runs
-        # they come out of init committed to the local default device, which
-        # a shard_map over a cross-process mesh cannot reshard implicitly.
-        params = jax.device_put(state.params, replicated_sharding(mesh))
-        state = state.replace(
-            params=params,
-            opt_state=init_sharded_opt_state(tx, params, mesh),
+    def build_state():
+        """Fresh TrainState from the run's flags — called once at startup
+        and again per --auto-resume attempt (the poisoned state was
+        donated into the aborted step; the loop's resume then restores
+        the last healthy checkpoint into this template)."""
+        state = create_train_state(
+            model, tx, (1, *init_hw, 3), jax.random.key(args.seed),
+            init_opt_state=not shard_update,
         )
-    if args.pretrained_backbone:
-        from batchai_retinanet_horovod_coco_tpu.models.import_weights import (
-            apply_backbone_weights,
-            convert_torch_resnet50,
-            load_state_dict,
-        )
+        if shard_update:
+            from batchai_retinanet_horovod_coco_tpu.parallel import (
+                init_sharded_opt_state,
+                replicated_sharding,
+            )
 
-        imp_params, imp_stats = convert_torch_resnet50(
-            load_state_dict(args.pretrained_backbone)
-        )
-        new_params, new_stats = apply_backbone_weights(
-            state.params, state.batch_stats, imp_params, imp_stats
-        )
-        state = state.replace(params=new_params, batch_stats=new_stats)
-        print(f"imported backbone weights from {args.pretrained_backbone}")
+            # Replicate params over the GLOBAL mesh first: on multi-host
+            # runs they come out of init committed to the local default
+            # device, which a shard_map over a cross-process mesh cannot
+            # reshard implicitly.
+            params = jax.device_put(state.params, replicated_sharding(mesh))
+            state = state.replace(
+                params=params,
+                opt_state=init_sharded_opt_state(tx, params, mesh),
+            )
+        if args.pretrained_backbone:
+            from batchai_retinanet_horovod_coco_tpu.models.import_weights import (
+                apply_backbone_weights,
+                convert_torch_resnet50,
+                load_state_dict,
+            )
+
+            imp_params, imp_stats = convert_torch_resnet50(
+                load_state_dict(args.pretrained_backbone)
+            )
+            new_params, new_stats = apply_backbone_weights(
+                state.params, state.batch_stats, imp_params, imp_stats
+            )
+            state = state.replace(params=new_params, batch_stats=new_stats)
+            print(f"imported backbone weights from {args.pretrained_backbone}")
+        return state
+
+    state = build_state()
 
     shard_index, shard_count = shard_info()
     if args.batch_size % shard_count:
@@ -865,6 +1059,12 @@ def _run(args) -> dict[str, float]:
                 )
 
                 state = CheckpointManager(args.snapshot_path).restore(state)
+                if mesh is None:
+                    # Restore returns HOST numpy; put once so the detect
+                    # programs don't re-transfer params on every dispatch
+                    # (read-only use — no donation — so a plain put is
+                    # safe here, unlike the training path).
+                    state = jax.device_put(state)
             if mesh is not None and shard_count == 1:
                 # Multi-host skips this: restored arrays are committed to
                 # local devices (cross-host device_put is unsupported on
@@ -879,65 +1079,153 @@ def _run(args) -> dict[str, float]:
             logger.log(int(state.step), metrics, prefix="eval")
             return metrics
 
-        train_batches = build_pipeline(
-            train_ds,
-            PipelineConfig(
-                batch_size=local_batch, shuffle=True,
-                transform=train_transform,
-                shard_index=shard_index, shard_count=shard_count,
-                **pipe_common,
-            ),
-            train=True,
+        # Durability surface (ISSUE 11).  The manifest records the
+        # data-order facts; --resume-elastic re-derives the stream
+        # position (consumed batches per process == restored step, at any
+        # world size — the global batch is validated unchanged).
+        numerics_dump_dir = (
+            getattr(args, "obs_dir", None) or args.log_dir or None
         )
-        try:
-            state = run_training(
-                model,
-                state,
-                train_batches,
-                num_classes,
-                LoopConfig(
-                    total_steps=args.steps,
-                    log_every=args.log_every,
-                    checkpoint_every=(
-                        args.checkpoint_every if args.snapshot_path else 0
-                    ),
-                    eval_every=args.eval_every,
-                    checkpoint_dir=args.snapshot_path,
-                    resume=not args.no_resume,
-                    profile_dir=args.profile_dir,
-                    device_prefetch=args.device_prefetch,
-                    async_eval=args.async_eval,
-                    # Numerics flight recorder (obs/numerics.py): the
-                    # in-step summary gate; the provenance dump lands in
-                    # the obs dir (or --log-dir without one) on a
-                    # tripped finite-check either way.
-                    numerics=getattr(args, "numerics", False),
-                    numerics_dump_dir=(
-                        getattr(args, "obs_dir", None)
-                        or args.log_dir
-                        or None
-                    ),
-                    rng_seed=args.seed,
-                ),
-                mesh=mesh,
-                schedule=schedule,
-                anchor_config=anchor_config,
-                shard_weight_update=shard_update,
-                quantized_allreduce=quantized,
-                allow_data_axis_divergence=args.allow_data_axis_divergence,
-                eval_fn=eval_fn
-                if (args.eval_every or args.dataset_type in ("coco", "pascal")
-                    or (args.dataset_type == "csv" and val_ds is not None))
-                else None,
-                logger=logger,
+        # MUTATED in place on --auto-resume: run_training builds a fresh
+        # CheckpointManager (which copies this dict) per attempt, so
+        # post-heal checkpoints record the EFFECTIVE stream facts — seed,
+        # exclusions, and the step the reseeded stream restarted at —
+        # which _elastic_skip_batches trusts over the CLI flags.
+        ckpt_metadata = {
+            "global_batch_size": args.batch_size,
+            "data_seed": args.seed,
+            "shard_count": shard_count,
+            "stream_base_step": 0,
+            "exclude_ids": [],
+        }
+        skip_batches = 0
+        data_seed = args.seed
+        exclude_ids: tuple[int, ...] = ()
+        if (
+            getattr(args, "resume_elastic", False)
+            and args.snapshot_path
+            and not args.no_resume
+        ):
+            stream_plan = _elastic_skip_batches(args)
+            skip_batches = stream_plan["skip"]
+            data_seed = stream_plan["data_seed"]
+            exclude_ids = stream_plan["exclude_ids"]
+            # The continuing run is the SAME stream: its checkpoints
+            # keep the stream identity (incl. a healed stream's base).
+            ckpt_metadata.update(
+                data_seed=data_seed,
+                exclude_ids=list(exclude_ids),
+                stream_base_step=stream_plan["stream_base_step"],
             )
-        finally:
-            # Deterministic pipeline teardown (previously left to the GC
-            # finalizer): decode workers/threads are reaped HERE, so shm
-            # workers export their trace files BEFORE main()'s obs
-            # finalize merges — a GC-time close would orphan them from
-            # trace.json.
-            train_batches.close()
+
+        loop_config = LoopConfig(
+            total_steps=args.steps,
+            log_every=args.log_every,
+            checkpoint_every=(
+                args.checkpoint_every if args.snapshot_path else 0
+            ),
+            eval_every=args.eval_every,
+            checkpoint_dir=args.snapshot_path,
+            resume=not args.no_resume,
+            profile_dir=args.profile_dir,
+            device_prefetch=args.device_prefetch,
+            async_eval=args.async_eval,
+            # Numerics flight recorder (obs/numerics.py): the in-step
+            # summary gate; the provenance dump lands in the obs dir (or
+            # --log-dir without one) on a tripped finite-check either way.
+            numerics=getattr(args, "numerics", False),
+            numerics_dump_dir=numerics_dump_dir,
+            rng_seed=args.seed,
+            ckpt_metadata=ckpt_metadata,
+        )
+        run_eval_fn = (
+            eval_fn
+            if (args.eval_every or args.dataset_type in ("coco", "pascal")
+                or (args.dataset_type == "csv" and val_ds is not None))
+            else None
+        )
+
+        # Self-healing numerics resume (--auto-resume): each attempt gets
+        # a fresh pipeline (reseeded, poison ids excluded) and a fresh
+        # state template; run_training's resume restores the last HEALTHY
+        # checkpoint (the pre-save gate keeps poisoned states off disk).
+        # data_seed/exclude_ids/skip_batches start from the elastic plan
+        # above (a virgin run: args.seed, none, 0).
+        attempt = 0
+        injector_latch = {"done": False}  # one injection per PROCESS
+        while True:
+            train_batches = build_pipeline(
+                train_ds,
+                PipelineConfig(
+                    batch_size=local_batch, shuffle=True,
+                    transform=train_transform,
+                    shard_index=shard_index, shard_count=shard_count,
+                    skip_batches=skip_batches, exclude_ids=exclude_ids,
+                    **{**pipe_common, "seed": data_seed},
+                ),
+                train=True,
+            )
+            batches = train_batches
+            if getattr(args, "inject_nan_step", None):
+                batches = _NanInjector(
+                    train_batches, args.inject_nan_step, injector_latch
+                )
+            try:
+                state = run_training(
+                    model,
+                    state,
+                    batches,
+                    num_classes,
+                    loop_config,
+                    mesh=mesh,
+                    schedule=schedule,
+                    anchor_config=anchor_config,
+                    shard_weight_update=shard_update,
+                    quantized_allreduce=quantized,
+                    allow_data_axis_divergence=args.allow_data_axis_divergence,
+                    eval_fn=run_eval_fn,
+                    logger=logger,
+                )
+                break
+            except FloatingPointError as exc:
+                attempt += 1
+                plan = _auto_resume_plan(args, attempt, exc)
+                if plan is None:
+                    raise
+                data_seed, exclude_ids = (
+                    plan["data_seed"],
+                    tuple(sorted(set(exclude_ids) | set(plan["exclude_ids"]))),
+                )
+                # A reseed is a NEW deterministic order starting at the
+                # restore step: skip nothing, and record the effective
+                # stream facts (seed, exclusions, base step) in every
+                # subsequent checkpoint's manifest so a later
+                # --resume-elastic re-derives THIS stream's position —
+                # not the aborted original's (which would silently
+                # replay/skip batches).
+                skip_batches = 0
+                ckpt_metadata.update(
+                    data_seed=data_seed,
+                    exclude_ids=list(exclude_ids),
+                    stream_base_step=plan["restored_step"],
+                )
+                # ONE structured auto_resume event per resume — in the
+                # JSONL next to the metrics it interrupts, and on stderr
+                # for bare runs.
+                payload = {**plan, "exclude_ids": list(exclude_ids)}
+                logger.event("auto_resume", **payload)
+                print(
+                    json.dumps({"event": "auto_resume", **payload}),
+                    file=sys.stderr, flush=True,
+                )
+                state = build_state()
+            finally:
+                # Deterministic pipeline teardown (previously left to the
+                # GC finalizer): decode workers/threads are reaped HERE,
+                # so shm workers export their trace files BEFORE main()'s
+                # obs finalize merges — a GC-time close would orphan them
+                # from trace.json.
+                train_batches.close()
         return {"final_step": float(int(state.step))}
     finally:
         if slo_monitor is not None:

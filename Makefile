@@ -23,7 +23,8 @@ CLUSTER = python -m batchai_retinanet_horovod_coco_tpu.launch.cluster
 	evalbench-check servebench servebench-check canaries \
 	convergence-full lint lint-obs check-static tune-smoke tunebench \
 	tunebench-check perf-report perf-report-check telemetry-smoke \
-	numerics-smoke chaos chaos-smoke ckptbench ckptbench-check
+	numerics-smoke chaos chaos-smoke ckptbench ckptbench-check \
+	fleet-smoke
 
 create:
 	$(CLUSTER) create --name $(NAME) --zone $(ZONE) --accelerator $(ACCEL) $(DRYFLAG)
@@ -153,6 +154,18 @@ chaos:
 chaos-smoke:
 	JAX_PLATFORMS=cpu python scripts/chaos.py --smoke
 
+# Serve-fleet chaos (ISSUE 12, scripts/chaos.py --serve): the REAL fleet
+# CLI over 2 stub-engine replica subprocesses — SIGKILL one mid-load and
+# assert every request completes or sheds WITH A REASON (zero hung
+# clients, zero silent drops), the router's /healthz stays 200 and its
+# /metrics scrape carries the fleet families throughout, and the circuit
+# breaker readmits the replica after the supervisor respawns it; then a
+# deliberately slow stub canary behind the SLO gate must produce EXACTLY
+# ONE canary_rollback event with the fleet back at baseline weights.
+# CPU-only, no dataset — wired into check-static.
+fleet-smoke:
+	JAX_PLATFORMS=cpu python scripts/chaos.py --serve
+
 # CKPTBENCH (ISSUE 11): the two durability numbers — async-save overhead
 # (wall of N checkpointed steps vs the same N without) and resume
 # time-to-first-step — committed as CKPTBENCH.json.  ckptbench-check
@@ -171,8 +184,8 @@ ckptbench-check:
 # run without touching an accelerator (chaos-smoke DOES run a few real
 # CPU training subprocesses over generated synthetic data — budget the
 # job for minutes, not seconds).
-check-static: lint telemetry-smoke numerics-smoke chaos-smoke
-	@echo "check-static: lint engine + watchdog audit + HLO collective audit + telemetry smoke + numerics smoke + chaos smoke all green"
+check-static: lint telemetry-smoke numerics-smoke chaos-smoke fleet-smoke
+	@echo "check-static: lint engine + watchdog audit + HLO collective audit + telemetry smoke + numerics smoke + chaos smoke + fleet smoke all green"
 
 # Static watchdog-coverage audit alone (ISSUE 3; now a shim over the lint
 # engine's watchdog-coverage rule — same CLI, same exit codes).  Also runs

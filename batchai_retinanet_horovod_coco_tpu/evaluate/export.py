@@ -87,6 +87,7 @@ def export_model(
     label_to_cat_id: dict[int, int] | None = None,
     image_min_side: int | None = None,
     image_max_side: int | None = None,
+    version: str | None = None,
 ) -> str:
     """Export one detection artifact per (shape bucket, batch size) + a
     manifest.
@@ -155,6 +156,10 @@ def export_model(
         # that produced the model's metrics did.  None on legacy exports.
         "image_min_side": image_min_side,
         "image_max_side": image_max_side,
+        # Rollout identity (ISSUE 12): the serve fleet's canary gate and
+        # router attribute per-replica health/weight by this; loaders
+        # fall back to the export dir's basename when absent.
+        "version": version,
         "class_names": class_names,
         "label_to_cat_id": (
             {str(k): v for k, v in label_to_cat_id.items()}

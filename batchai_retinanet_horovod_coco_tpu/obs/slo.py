@@ -292,6 +292,14 @@ class SloMonitor:
         self._thread.start()
         return self
 
+    def request_stop(self) -> None:
+        """Signal the poll loop to exit WITHOUT joining — safe to call
+        from the poll thread itself (e.g. an ``on_violation`` handler
+        that terminally resolves the monitored condition, like the
+        fleet canary gate's rollback).  ``stop()`` from another thread
+        still performs the full join + final evaluation."""
+        self._stop.set()
+
     def stop(self) -> None:
         started = self._thread is not None
         self._stop.set()

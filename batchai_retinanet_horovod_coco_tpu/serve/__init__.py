@@ -16,6 +16,12 @@ Layers (one module each; RUNBOOK §10 is the operator guide):
 - ``batcher``  — per-bucket coalescing under the latency deadline
 - ``frontend`` — ``DetectionServer`` (admission/shedding/drain), the
   stdlib HTTP frontend, and the ``python -m …serve`` CLI
+- ``replica``  — uniform replica handles (in-process / HTTP subprocess)
+- ``fleet``    — ``FleetRouter``: health-weighted routing over N
+  replicas, circuit breaking, fleet admission control, SLO-gated canary
+  rollout (ISSUE 12; RUNBOOK §18), + the fleet HTTP frontend and the
+  ``python -m …serve.fleet`` CLI
+- ``stub``     — the canonical no-device stub engine (smoke/chaos/tests)
 """
 
 from batchai_retinanet_horovod_coco_tpu.serve.common import (
@@ -29,21 +35,37 @@ from batchai_retinanet_horovod_coco_tpu.serve.common import (
     ServerError,
 )
 from batchai_retinanet_horovod_coco_tpu.serve.engine import DetectEngine
+from batchai_retinanet_horovod_coco_tpu.serve.fleet import (
+    FleetConfig,
+    FleetRouter,
+    serve_fleet_http,
+)
 from batchai_retinanet_horovod_coco_tpu.serve.frontend import (
     DetectionServer,
     serve_http,
+)
+from batchai_retinanet_horovod_coco_tpu.serve.replica import (
+    HttpReplica,
+    LocalReplica,
+    ReplicaUnavailable,
 )
 
 __all__ = [
     "DetectEngine",
     "DetectionServer",
     "DetectionFuture",
+    "FleetConfig",
+    "FleetRouter",
+    "HttpReplica",
     "LatencyStats",
+    "LocalReplica",
+    "ReplicaUnavailable",
     "RequestRejected",
     "RequestTimeout",
     "ServeConfig",
     "ServeError",
     "ServerClosed",
     "ServerError",
+    "serve_fleet_http",
     "serve_http",
 ]

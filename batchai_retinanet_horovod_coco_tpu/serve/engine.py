@@ -57,6 +57,7 @@ class DetectEngine:
         max_side: int,
         label_to_cat_id: dict[int, int] | None = None,
         source: str = "live",
+        version: str = "live",
     ):
         if not fns:
             raise ValueError("engine needs at least one (bucket, batch) program")
@@ -67,6 +68,11 @@ class DetectEngine:
             label_to_cat_id if label_to_cat_id else IdentityLabelMap()
         )
         self.source = source
+        # The model/rollout identity the fleet router and canary gate
+        # attribute weight by (ISSUE 12): the export manifest's recorded
+        # version, the export dir's basename as a fallback on legacy
+        # manifests, or "live" for from_state engines.
+        self.version = version
         self.buckets: tuple[tuple[int, int], ...] = tuple(sorted(fns))
 
     # ---- table lookups ---------------------------------------------------
@@ -154,7 +160,15 @@ class DetectEngine:
         max_side = manifest.get("image_max_side") or max(
             max(hw) for hw in buckets
         )
-        return cls(fns, min_side, max_side, label_map, source=export_dir)
+        import os
+
+        version = manifest.get("version") or os.path.basename(
+            os.path.normpath(export_dir)
+        )
+        return cls(
+            fns, min_side, max_side, label_map, source=export_dir,
+            version=str(version),
+        )
 
     @classmethod
     def from_state(

@@ -1,0 +1,1075 @@
+"""Fault-tolerant serve fleet: replicated engines behind a health-weighted
+router with circuit breaking, fleet admission control, and an SLO-gated
+canary rollout (ISSUE 12 — the serve-side sibling of the elastic-training
+PR).
+
+``FleetRouter`` consumes exactly the read surface PR 8 shaped "for a
+future fleet router": each replica's ``/healthz`` 200 payload carries
+``load_fields()`` (replica_id, version, inflight, queue depth vs bound,
+windowed p99, accepting), and the router turns those into routing
+weights — continuously, via a health poller on an injectable clock, so
+the whole state machine is testable without sleeping
+(tests/unit/test_fleet.py).
+
+The machines:
+
+- **Weighting** (``replica_weight``): queue headroom x inflight damping
+  x relative p99 — a loaded or slow replica takes proportionally less
+  traffic *before* it gets sick enough to shed.
+- **Circuit breaker** (per replica): CLOSED → OPEN on a health-poll
+  failure/503 or a dead-replica request failure (and on
+  ``shed_trip`` *consecutive* request sheds — one 503 under load is
+  shedding working-as-designed, a run of them is a sick replica);
+  OPEN → HALF_OPEN when the breaker's bounded backoff (+ deterministic
+  per-replica jitter, utils/backoff.py) elapses; the next health poll IS
+  the half-open probe — success readmits (CLOSED, weight restored),
+  failure re-opens with the next backoff step.
+- **Re-dispatch**: a request in flight on a replica that dies under it
+  (``ReplicaUnavailable``) is re-dispatched to another replica AT MOST
+  ``redispatch_limit`` (default once), and only if its deadline allows.
+  A replica-level shed (503) is retried on another replica under the
+  SAME bounded budget (a racing shed must not fail a request the rest
+  of the fleet had headroom for); timeouts and ``decode_error`` are
+  request outcomes, never retried.
+- **Fleet admission control**: over ``max_inflight`` (default: the sum
+  of the replicas' advertised admission capacities) the fleet sheds at
+  the edge with ``fleet_overloaded``; with no routable replica it sheds
+  ``no_replica_available`` — overload never queues into a sick replica.
+- **Canary gate** (``add_canary``): a replica from a different export
+  ``version`` takes ``canary_weight``-scaled traffic while a DEDICATED
+  ``SloMonitor`` (obs/slo.py — the anti-flap/once-per-sustained-breach
+  machinery, on the same injectable clock) watches its p99 ratio vs the
+  fleet baseline and its shed rate.  A sustained breach drains it and
+  rolls the fleet back to baseline weights with exactly ONE structured
+  ``canary_rollback`` event — measured feedback drives the rollout, no
+  human in the loop (the TVM lesson, applied to deployment).
+
+Everything observable lands in ``router.telemetry`` (fleet latency
+summary, per-replica weight/breaker gauges, shed/redispatch/rollback
+counters) — scraped by ``GET /metrics`` on the fleet frontend
+(``serve_fleet_http``) exactly like a single replica's.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+import sys
+import threading
+import zlib
+from typing import Any
+
+from batchai_retinanet_horovod_coco_tpu.obs import trace, watchdog
+from batchai_retinanet_horovod_coco_tpu.obs.telemetry import Registry
+from batchai_retinanet_horovod_coco_tpu.obs.trace import monotonic_s
+from batchai_retinanet_horovod_coco_tpu.serve.common import (
+    LatencyStats,
+    RequestRejected,
+    RequestTimeout,
+    ServerError,
+)
+from batchai_retinanet_horovod_coco_tpu.serve.replica import (
+    ReplicaUnavailable,
+)
+from batchai_retinanet_horovod_coco_tpu.utils.backoff import BackoffPolicy
+
+# Breaker states (also the fleet_breaker_state gauge encoding).
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+DRAINED = "drained"  # canary rolled back / replica administratively out
+_STATE_CODE = {CLOSED: 0.0, HALF_OPEN: 1.0, OPEN: 2.0, DRAINED: 3.0}
+
+
+def replica_weight(load: dict | None, p99_ref: float | None = None) -> float:
+    """One replica's routing weight from its advertised load fields.
+
+    ``headroom / (1 + inflight/capacity)``, scaled down by
+    ``p99_ref / p99`` when this replica's windowed p99 is worse than the
+    fleet's best (``p99_ref``).  0 means unroutable: not accepting, or
+    no admission headroom left (the edge sheds instead of queueing).
+    Pure — pinned exactly by tests/unit/test_fleet.py.
+    """
+    if not load or not load.get("accepting", False):
+        return 0.0
+    cap = max(1, int(load.get("admission_capacity") or 1))
+    qsize = max(0, int(load.get("admission_qsize") or 0))
+    headroom = max(0.0, 1.0 - qsize / cap)
+    inflight = max(0, int(load.get("inflight") or 0))
+    w = headroom / (1.0 + inflight / cap)
+    p99 = load.get("p99_ms")
+    if p99 and p99_ref and float(p99) > 0 and float(p99_ref) > 0:
+        w *= min(1.0, float(p99_ref) / float(p99))
+    return round(w, 6)
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Router knobs.  The breaker backoff is a shared ``BackoffPolicy``;
+    each replica derives a deterministic per-replica jitter seed from its
+    id, so probe schedules are reproducible but decorrelated."""
+
+    poll_interval_s: float = 1.0
+    # Health-poll failures/503s before a CLOSED breaker opens (1 = the
+    # first failed poll opens it — a poll failure is already a timeout's
+    # worth of evidence).
+    fail_threshold: int = 1
+    # CONSECUTIVE request-level sheds before the breaker opens (sheds are
+    # load signals first; a run of them is a sick replica).
+    shed_trip: int = 3
+    # Half-open probe cadence after the breaker opens.
+    probe_backoff: BackoffPolicy = BackoffPolicy(
+        max_tries=1_000_000, base_s=0.5, multiplier=2.0, ceiling_s=10.0,
+        jitter=0.2,
+    )
+    # Fleet admission bound; None = sum of advertised admission
+    # capacities of routable replicas (re-derived as replicas come/go).
+    max_inflight: int | None = None
+    # Default per-request deadline at the fleet edge.
+    default_timeout_s: float | None = 30.0
+    # A dead replica's in-flight requests are re-dispatched at most this
+    # many times (deadline allowing).
+    redispatch_limit: int = 1
+    # Canary weight fraction while under SLO evaluation.
+    canary_weight: float = 0.25
+    # Canary gate rules: p99 ratio vs fleet baseline + shed-per-poll.
+    canary_p99_factor: float = 1.5
+    canary_shed_per_poll: float = 0.0
+    canary_for_s: float = 5.0
+    canary_poll_s: float = 1.0
+    # Canary drain budget on rollback (LocalReplica close bound).
+    canary_drain_timeout_s: float = 5.0
+    latency_window: int = 4096
+    seed: int = 0
+
+
+class _ReplicaState:
+    __slots__ = (
+        "replica", "state", "weight", "load", "poll_failures",
+        "shed_strikes", "open_count", "next_probe_t", "is_canary",
+    )
+
+    def __init__(self, replica, is_canary: bool = False):
+        self.replica = replica
+        self.state = CLOSED
+        self.weight = 0.0
+        self.load: dict = {}
+        self.poll_failures = 0
+        self.shed_strikes = 0
+        self.open_count = 0  # backoff step for the half-open probe
+        self.next_probe_t = 0.0
+        self.is_canary = is_canary
+
+
+class FleetRouter:
+    """N replicas behind one weighted, breaker-guarded ``detect()``.
+
+    ``detect()`` is blocking and thread-safe (the fleet HTTP frontend
+    calls it from per-request handler threads); ``poll_once(now=...)``
+    advances the health/breaker state machine on an injectable clock —
+    ``start_polling()`` runs it on a watchdog-registered thread in
+    production, tests drive it directly.
+    """
+
+    def __init__(
+        self,
+        replicas: list,
+        config: FleetConfig = FleetConfig(),
+        sink: Any = None,
+        auto_poll: bool = True,
+        initial_poll: bool = True,
+    ):
+        if not replicas:
+            raise ValueError("a fleet needs at least one replica")
+        self.config = config
+        self.sink = sink
+        self.stats = LatencyStats(window=config.latency_window)
+        self._states = [_ReplicaState(r) for r in replicas]
+        self._lock = threading.Lock()
+        self._rng = random.Random(config.seed)
+        self._accepting = True
+        self._inflight = 0
+        self._error: BaseException | None = None
+        self._redispatches = 0
+        self._breaker_opens = 0
+        self._rollbacks = 0
+        # Canary machinery (armed by add_canary).
+        self._canary: _ReplicaState | None = None
+        self._canary_monitor = None
+        self._canary_outcome: str | None = None  # None|rolled_back|promoted
+
+        self.telemetry = Registry()
+        self.telemetry.histogram(
+            "fleet_request_latency_ms",
+            "fleet-edge request latency over the recent window",
+            source=self.stats.window_ms,
+        )
+        self.telemetry.register_collector(self._telemetry_samples)
+
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        if initial_poll:
+            self.poll_once()
+        if auto_poll:
+            self.start_polling()
+
+    # ---- identity helpers ------------------------------------------------
+
+    def _backoff_for(self, st: _ReplicaState) -> BackoffPolicy:
+        """The shared probe policy, re-seeded per replica id so probe
+        jitter is deterministic yet decorrelated across the fleet."""
+        seed = zlib.crc32(str(st.replica.replica_id).encode())
+        return dataclasses.replace(
+            self.config.probe_backoff, seed=self.config.seed ^ seed
+        )
+
+    # ---- health poll + breaker state machine -----------------------------
+
+    def poll_once(self, now: float | None = None) -> None:
+        """One health sweep: poll every replica that is due, apply the
+        breaker transitions, recompute weights.  Injectable ``now``."""
+        now = monotonic_s() if now is None else now
+        for st in list(self._states):
+            with self._lock:
+                if st.state == DRAINED:
+                    continue
+                if st.state == OPEN:
+                    if now < st.next_probe_t:
+                        continue  # still backing off
+                    st.state = HALF_OPEN  # this poll IS the probe
+            try:
+                code, payload = st.replica.healthz()
+            except Exception as exc:  # a poller can never crash on a replica
+                code, payload = 0, {"status": "poll_error", "error": repr(exc)}
+            self._apply_poll(st, code, payload, now)
+        self._recompute_weights()
+
+    def _apply_poll(
+        self, st: _ReplicaState, code: int, payload: dict, now: float
+    ) -> None:
+        with self._lock:
+            if code == 200:
+                st.load = dict(payload.get("load") or {})
+                st.poll_failures = 0
+                st.shed_strikes = 0
+                if st.state in (OPEN, HALF_OPEN):
+                    st.state = CLOSED
+                    st.open_count = 0
+                    self._emit_event(
+                        "fleet_breaker_close",
+                        replica_id=st.replica.replica_id,
+                    )
+                return
+            # Unhealthy poll (503 / unreachable / crashed).
+            st.poll_failures += 1
+            if st.state == CLOSED:
+                if st.poll_failures >= self.config.fail_threshold:
+                    self._open_locked(
+                        st, now,
+                        reason=str(payload.get("status") or f"healthz_{code}"),
+                    )
+            elif st.state == HALF_OPEN:
+                # Probe failed: back to OPEN with the next backoff step.
+                self._open_locked(st, now, reason="half_open_probe_failed",
+                                  quiet=True)
+
+    def _open_locked(
+        self, st: _ReplicaState, now: float, reason: str, quiet: bool = False
+    ) -> None:
+        """Transition to OPEN and schedule the half-open probe (caller
+        holds the lock)."""
+        st.state = OPEN
+        st.weight = 0.0
+        delay = self._backoff_for(st).delay_s(st.open_count)
+        st.open_count += 1
+        st.next_probe_t = now + delay
+        self._breaker_opens += 1
+        if not quiet:
+            self._emit_event(
+                "fleet_breaker_open",
+                replica_id=st.replica.replica_id,
+                reason=reason,
+                probe_in_s=round(delay, 3),
+            )
+
+    def _note_request_failure(self, st: _ReplicaState) -> None:
+        """A request found this replica dead (``ReplicaUnavailable``):
+        open the breaker immediately — don't wait for the next poll."""
+        with self._lock:
+            if st.state in (CLOSED, HALF_OPEN):
+                self._open_locked(st, monotonic_s(), reason="request_failed")
+
+    def _note_request_shed(self, st: _ReplicaState) -> None:
+        """A request-level 503: a load signal first, a breaker signal
+        after ``shed_trip`` CONSECUTIVE ones."""
+        with self._lock:
+            st.shed_strikes += 1
+            if st.state == CLOSED and st.shed_strikes >= self.config.shed_trip:
+                self._open_locked(
+                    st, monotonic_s(), reason="consecutive_sheds"
+                )
+
+    def _recompute_weights(self) -> None:
+        with self._lock:
+            routable = [
+                st for st in self._states
+                if st.state == CLOSED and st.load.get("accepting", False)
+            ]
+            p99s = [
+                float(st.load["p99_ms"]) for st in routable
+                if st.load.get("p99_ms")
+            ]
+            p99_ref = min(p99s) if p99s else None
+            for st in self._states:
+                if st.state != CLOSED:
+                    st.weight = 0.0
+                    continue
+                w = replica_weight(st.load, p99_ref)
+                if st.is_canary and self._canary_outcome is None:
+                    w *= self.config.canary_weight
+                st.weight = w
+
+    # ---- routing ---------------------------------------------------------
+
+    def _pick(self, exclude: set[int]) -> _ReplicaState | None:
+        with self._lock:
+            candidates = [
+                st for st in self._states
+                if st.state == CLOSED and st.weight > 0.0
+                and id(st) not in exclude
+            ]
+            if not candidates:
+                return None
+            total = sum(st.weight for st in candidates)
+            x = self._rng.random() * total
+            for st in candidates:
+                x -= st.weight
+                if x <= 0.0:
+                    return st
+            return candidates[-1]
+
+    def _fleet_capacity(self) -> int:
+        if self.config.max_inflight is not None:
+            return self.config.max_inflight
+        with self._lock:
+            caps = [
+                int(st.load.get("admission_capacity") or 0)
+                for st in self._states
+                if st.state == CLOSED
+            ]
+        return max(1, sum(caps))
+
+    def detect(self, payload, timeout_s: float | None = None) -> list[dict]:
+        """Route one request; blocking.  Raises the serve taxonomy:
+        ``RequestRejected(reason)`` on any shed (fleet edge or replica),
+        ``RequestTimeout`` past the deadline, ``ServerError`` when every
+        eligible replica failed underneath it."""
+        self._raise_pending()
+        t0 = monotonic_s()
+        if timeout_s is None:
+            timeout_s = self.config.default_timeout_s
+        deadline = None if timeout_s is None else t0 + timeout_s
+        with self._lock:
+            accepting = self._accepting
+        if not accepting:
+            self.stats.record_shed("shutting_down")
+            raise RequestRejected("shutting_down")
+        cap = self._fleet_capacity()
+        with self._lock:
+            if self._inflight >= cap:
+                over = True
+            else:
+                over = False
+                self._inflight += 1
+        if over:
+            self.stats.record_shed("fleet_overloaded")
+            raise RequestRejected(
+                "fleet_overloaded", f"fleet inflight at capacity {cap}"
+            )
+        try:
+            return self._dispatch(payload, deadline, t0)
+        finally:
+            with self._lock:
+                self._inflight -= 1
+
+    def _dispatch(self, payload, deadline, t0: float) -> list[dict]:
+        tried: set[int] = set()
+        last_exc: BaseException | None = None
+        attempts = self.config.redispatch_limit + 1
+        for attempt in range(attempts):
+            now = monotonic_s()
+            if deadline is not None and now >= deadline:
+                self.stats.record_timeout()
+                raise RequestTimeout(
+                    "fleet deadline expired before dispatch"
+                ) from last_exc
+            st = self._pick(tried)
+            if st is None:
+                if last_exc is None:
+                    self.stats.record_shed("no_replica_available")
+                    raise RequestRejected(
+                        "no_replica_available",
+                        "no routable replica (breakers open or zero headroom)",
+                    )
+                break  # a failure with no alternate left — classify below
+            tried.add(id(st))
+            if attempt > 0:
+                with self._lock:
+                    self._redispatches += 1
+                trace.instant(
+                    "fleet_redispatch", replica=st.replica.replica_id
+                )
+            remaining = None if deadline is None else deadline - now
+            try:
+                dets = st.replica.detect(payload, timeout_s=remaining)
+            except ReplicaUnavailable as exc:
+                self._note_request_failure(st)
+                self._recompute_weights()
+                last_exc = exc
+                continue  # deadline-checked at the top of the loop
+            except RequestRejected as exc:
+                if exc.reason == "decode_error":
+                    # The client's fault — never a breaker/redispatch signal.
+                    self.stats.record_shed(exc.reason)
+                    raise
+                self._note_request_shed(st)
+                last_exc = exc
+                continue
+            except RequestTimeout:
+                self.stats.record_timeout()
+                raise
+            with self._lock:
+                st.shed_strikes = 0
+            self.stats.record(monotonic_s() - t0)
+            return dets
+        # Exhausted: classify by the last replica-side outcome.
+        if isinstance(last_exc, RequestRejected):
+            self.stats.record_shed(last_exc.reason)
+            raise last_exc
+        self.stats.record_failure()
+        err = ServerError(
+            "every eligible replica failed this request "
+            f"(redispatch limit {self.config.redispatch_limit})"
+        )
+        err.__cause__ = last_exc
+        raise err
+
+    def _raise_pending(self) -> None:
+        if self._error is not None:
+            raise ServerError("fleet health poller crashed") from self._error
+
+    # ---- canary gate -----------------------------------------------------
+
+    def add_canary(self, replica, start_monitor: bool = False):
+        """Admit ``replica`` as the canary: it takes ``canary_weight``-
+        scaled traffic while a dedicated SloMonitor watches its p99
+        ratio vs the fleet baseline and its shed rate.  Returns the
+        monitor (tests drive ``canary_check_once``; production passes
+        ``start_monitor=True`` for the poll thread)."""
+        from batchai_retinanet_horovod_coco_tpu.obs import slo as slo_lib
+
+        if self._canary is not None:
+            raise ValueError("a canary is already under evaluation")
+        # A previous canary generation's monitor (rolled back — its
+        # rollback handler could only request_stop from its own poll
+        # thread) is fully stopped here, from a safe caller thread.
+        if self._canary_monitor is not None:
+            self._canary_monitor.stop()
+            self._canary_monitor = None
+        st = _ReplicaState(replica, is_canary=True)
+        with self._lock:
+            self._states.append(st)
+        self._canary = st
+        self._canary_outcome = None
+        cfg = self.config
+        self._canary_monitor = slo_lib.SloMonitor(
+            self.telemetry,
+            [
+                slo_lib.SloRule(
+                    name="canary-p99-regression",
+                    metric="fleet_canary_p99_ratio",
+                    op=">",
+                    threshold=cfg.canary_p99_factor,
+                    for_s=cfg.canary_for_s,
+                    description=(
+                        f"canary p99 above {cfg.canary_p99_factor}x the "
+                        "fleet baseline"
+                    ),
+                ),
+                slo_lib.SloRule(
+                    name="canary-shed-rate",
+                    metric="fleet_canary_shed_total",
+                    delta=True,
+                    op=">",
+                    threshold=cfg.canary_shed_per_poll,
+                    for_s=cfg.canary_for_s,
+                    description=(
+                        "canary shedding above "
+                        f"{cfg.canary_shed_per_poll}/poll"
+                    ),
+                ),
+            ],
+            sink=self.sink,
+            poll_interval=cfg.canary_poll_s,
+            on_violation=self._canary_rollback,
+        )
+        self.poll_once()
+        self._emit_event(
+            "canary_started",
+            replica_id=replica.replica_id,
+            version=replica.version,
+            weight_fraction=cfg.canary_weight,
+        )
+        if start_monitor:
+            self._canary_monitor.start()
+        return self._canary_monitor
+
+    def canary_check_once(self, now: float | None = None) -> list[dict]:
+        """One canary-gate evaluation (injectable clock — the SLO
+        monitor's own anti-flap state machine underneath)."""
+        if self._canary_monitor is None:
+            return []
+        return self._canary_monitor.check_once(now=now)
+
+    def _canary_rollback(self, violation: dict) -> None:
+        """A sustained canary breach: drain it, restore baseline weights,
+        emit exactly ONE structured ``canary_rollback`` event.  The SLO
+        monitor fires once per sustained breach; the outcome latch makes
+        rollback terminal for this canary generation regardless."""
+        with self._lock:
+            if self._canary is None or self._canary_outcome is not None:
+                return
+            self._canary_outcome = "rolled_back"
+            st = self._canary
+            st.state = DRAINED
+            st.weight = 0.0
+            self._rollbacks += 1
+            # Free the canary slot: a fixed v3 export can be admitted
+            # without restarting the router (the drained replica stays
+            # in _states for /fleet visibility, weight pinned 0).
+            self._canary = None
+        self._emit_event(
+            "canary_rollback",
+            replica_id=st.replica.replica_id,
+            version=st.replica.version,
+            rule=violation.get("rule"),
+            value=violation.get("value"),
+            threshold=violation.get("threshold"),
+            sustained_s=violation.get("sustained_s"),
+        )
+        if self._canary_monitor is not None:
+            # Rollback is terminal for this generation — stop the gate's
+            # poll loop.  request_stop (not stop): this handler may be
+            # running ON the monitor's own poll thread, which cannot
+            # join itself; add_canary/close finish the join later.
+            self._canary_monitor.request_stop()
+        try:
+            st.replica.drain(timeout_s=self.config.canary_drain_timeout_s)
+        except Exception:
+            pass  # the drain is best-effort; the weight is already zero
+        self._recompute_weights()
+
+    def promote_canary(self) -> None:
+        """Manually graduate a green canary to full weight."""
+        with self._lock:
+            if self._canary is None or self._canary_outcome is not None:
+                return
+            self._canary_outcome = "promoted"
+            st = self._canary
+            st.is_canary = False
+            self._canary = None
+        self._emit_event(
+            "canary_promoted",
+            replica_id=st.replica.replica_id,
+            version=st.replica.version,
+        )
+        if self._canary_monitor is not None:
+            self._canary_monitor.stop()
+            self._canary_monitor = None
+        self._recompute_weights()
+
+    # ---- observability ---------------------------------------------------
+
+    def _emit_event(self, kind: str, **fields) -> None:
+        record = {"event": kind, **fields}
+        trace.instant(kind, **fields)
+        if self.sink is not None:
+            try:
+                self.sink.event(kind, **fields)
+            except Exception:
+                pass  # a broken sink must not mask the stderr line
+        print(json.dumps(record), file=sys.stderr, flush=True)
+
+    def _canary_baseline_p99(self) -> float | None:
+        """Median p99 over CLOSED non-canary replicas (the fleet
+        baseline the canary regresses against)."""
+        p99s = sorted(
+            float(st.load["p99_ms"])
+            for st in self._states
+            if not st.is_canary and st.state == CLOSED
+            and st.load.get("p99_ms")
+        )
+        if not p99s:
+            return None
+        mid = len(p99s) // 2
+        return (
+            p99s[mid] if len(p99s) % 2
+            else (p99s[mid - 1] + p99s[mid]) / 2.0
+        )
+
+    def _telemetry_samples(self):
+        snap = self.stats.snapshot()
+        with self._lock:
+            states = [
+                (st.replica.replica_id, st.state, st.weight,
+                 dict(st.load), st.is_canary)
+                for st in self._states
+            ]
+            redispatches = self._redispatches
+            opens = self._breaker_opens
+            rollbacks = self._rollbacks
+            inflight = self._inflight
+            canary = self._canary
+            outcome = self._canary_outcome
+        yield ("fleet_requests_completed_total", "counter",
+               "requests completed through the fleet router", None,
+               snap["completed"])
+        yield ("fleet_requests_failed_total", "counter",
+               "requests failed after exhausting re-dispatch", None,
+               snap["failed"])
+        yield ("fleet_requests_timeout_total", "counter",
+               "requests expired at the fleet edge", None, snap["timeouts"])
+        for reason, n in sorted(snap["shed"].items()):
+            yield ("fleet_shed_total", "counter",
+                   "requests shed at the fleet edge, by reason",
+                   {"reason": reason}, n)
+        yield ("fleet_redispatch_total", "counter",
+               "requests retried on another replica (replica death or "
+               "replica-level shed)", None,
+               float(redispatches))
+        yield ("fleet_breaker_open_total", "counter",
+               "circuit-breaker open transitions", None, float(opens))
+        yield ("fleet_canary_rollback_total", "counter",
+               "canary rollbacks (exactly one per failed canary)", None,
+               float(rollbacks))
+        yield ("fleet_inflight", "gauge",
+               "requests inside the fleet edge right now", None,
+               float(inflight))
+        for rid, state, weight, load, is_canary in states:
+            yield ("fleet_replica_weight", "gauge",
+                   "routing weight from advertised load fields",
+                   {"replica": rid}, round(weight, 6))
+            yield ("fleet_breaker_state", "gauge",
+                   "0=closed 1=half_open 2=open 3=drained",
+                   {"replica": rid}, _STATE_CODE[state])
+            if load.get("p99_ms"):
+                yield ("fleet_replica_p99_ms", "gauge",
+                       "replica-advertised windowed p99",
+                       {"replica": rid}, float(load["p99_ms"]))
+        if canary is not None and outcome is None:
+            base = self._canary_baseline_p99()
+            c_p99 = canary.load.get("p99_ms")
+            if base and c_p99:
+                yield ("fleet_canary_p99_ratio", "gauge",
+                       "canary p99 / fleet-baseline p99 (the canary "
+                       "gate's regression metric)", None,
+                       round(float(c_p99) / base, 4))
+            yield ("fleet_canary_shed_total", "counter",
+                   "canary-advertised lifetime sheds (gate delta rule)",
+                   None, float(canary.load.get("shed_total") or 0))
+
+    def status(self) -> dict:
+        """The /fleet debugging payload: per-replica identity, breaker
+        state, weight, last load fields; canary outcome; counters."""
+        with self._lock:
+            replicas = [
+                {
+                    "replica_id": st.replica.replica_id,
+                    "version": st.replica.version,
+                    "state": st.state,
+                    "weight": round(st.weight, 6),
+                    "is_canary": st.is_canary,
+                    "load": dict(st.load),
+                }
+                for st in self._states
+            ]
+            out = {
+                "accepting": self._accepting,
+                "inflight": self._inflight,
+                "redispatches": self._redispatches,
+                "breaker_opens": self._breaker_opens,
+                "canary_rollbacks": self._rollbacks,
+                "canary_outcome": self._canary_outcome,
+            }
+        out["replicas"] = replicas
+        out["stats"] = self.stats.snapshot()
+        return out
+
+    def healthz(self) -> tuple[int, dict]:
+        """Fleet liveness: 200 while at least one replica is routable
+        (breaker CLOSED) — a degraded fleet still serves; 503 when none
+        is."""
+        with self._lock:
+            closed = sum(1 for st in self._states if st.state == CLOSED)
+            total = len(self._states)
+        payload = {
+            "status": "ok" if closed else "no_replicas",
+            "replicas_closed": closed,
+            "replicas_total": total,
+        }
+        return (200 if closed else 503), payload
+
+    # ---- poll thread + lifecycle -----------------------------------------
+
+    def _poll_run(self, hb: watchdog.Heartbeat) -> None:
+        try:
+            while not self._stop.wait(self.config.poll_interval_s):
+                hb.beat()
+                self.poll_once()
+        except BaseException as e:
+            # Crash channel (thread-error-contract): a dead poller means
+            # frozen weights — store it so detect() re-raises, and say so.
+            self._error = e
+            print(
+                json.dumps(
+                    {"event": "fleet_poller_crashed", "error": repr(e)}
+                ),
+                file=sys.stderr, flush=True,
+            )
+            raise
+        finally:
+            hb.close()
+
+    def start_polling(self) -> "FleetRouter":
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        hb = watchdog.register("fleet-health-poll")
+        self._thread = threading.Thread(
+            target=self._poll_run, args=(hb,), daemon=True,
+            name="fleet-health-poll",
+        )
+        self._thread.start()
+        return self
+
+    def close(self, close_replicas: bool = False) -> None:
+        """Stop accepting, stop the poller and canary monitor; bounded
+        and idempotent.  Spawned replica processes belong to the caller
+        (the CLI kills its children); ``close_replicas`` closes the
+        replica HANDLES (in-process servers) too."""
+        with self._lock:
+            self._accepting = False
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if self._canary_monitor is not None:
+            self._canary_monitor.stop()
+        if close_replicas:
+            for st in self._states:
+                try:
+                    st.replica.close()
+                except Exception:
+                    pass  # teardown is best-effort by design
+
+    def __enter__(self) -> "FleetRouter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# ---------------------------------------------------------------------------
+# Fleet HTTP frontend
+# ---------------------------------------------------------------------------
+
+
+def serve_fleet_http(
+    router: FleetRouter,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    request_timeout_s: float = 60.0,
+):
+    """The fleet edge as HTTP — same surface as a single replica's
+    frontend, so clients and scrapers cannot tell one engine from N:
+
+    POST /detect   → 200 detections; 503 + reason on shed; 504 on
+                   deadline; 500 when every replica failed
+    GET  /healthz  → 200 while >= 1 replica is routable, else 503
+    GET  /metrics  → Prometheus text over ``router.telemetry``
+    GET  /fleet    → per-replica status JSON (also /statusz)
+
+    Returns the ``ThreadingHTTPServer``; the caller owns
+    ``serve_forever()``/``shutdown()`` (the CLI below runs it).
+    """
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def _json(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802 (stdlib casing)
+            if self.path == "/healthz":
+                code, payload = router.healthz()
+                self._json(code, payload)
+            elif self.path == "/metrics":
+                body = router.telemetry.prometheus_text().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4; charset=utf-8"
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif self.path in ("/fleet", "/statusz"):
+                self._json(200, router.status())
+            else:
+                self._json(404, {"error": "not_found"})
+
+        def do_POST(self):  # noqa: N802
+            if self.path != "/detect":
+                self._json(404, {"error": "not_found"})
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length)
+            try:
+                dets = router.detect(body, timeout_s=request_timeout_s)
+            except RequestRejected as exc:
+                code = 400 if exc.reason == "decode_error" else 503
+                self._json(code, {"error": "rejected", "reason": exc.reason})
+            except (RequestTimeout, TimeoutError):
+                self._json(504, {"error": "deadline_exceeded"})
+            except Exception as exc:
+                self._json(500, {"error": "server_error", "detail": str(exc)})
+            else:
+                self._json(200, {"detections": dets})
+
+        def log_message(self, *args) -> None:
+            pass  # request logging is the telemetry layer's job
+
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    httpd.daemon_threads = True  # a wedged client can't hold exit hostage
+    return httpd
+
+
+# ---------------------------------------------------------------------------
+# CLI: python -m batchai_retinanet_horovod_coco_tpu.serve.fleet
+# ---------------------------------------------------------------------------
+
+
+def build_parser():
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="Fleet router over N serve replicas: health-weighted "
+                    "routing, circuit breaking, fleet admission control, "
+                    "SLO-gated canary rollout.",
+    )
+    p.add_argument("--http", type=int, required=True, metavar="PORT",
+                   help="fleet frontend port (0 = ephemeral, printed)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--replica", action="append", default=[],
+                   metavar="URL",
+                   help="attach an already-running replica frontend "
+                        "(repeatable)")
+    p.add_argument("--spawn", type=int, default=0, metavar="N",
+                   help="spawn N replica subprocesses via the serve CLI "
+                        "(pinned ports; supervised unless --no-respawn)")
+    p.add_argument("--export-dir", default=None,
+                   help="export directory for spawned replicas "
+                        "(omit with --stub-engine)")
+    p.add_argument("--stub-engine", action="store_true",
+                   help="spawned replicas use the stub engine (no device "
+                        "work — smoke/chaos harnesses)")
+    p.add_argument("--stub-delay-ms", type=float, default=None,
+                   help="stub engine per-dispatch delay for spawned "
+                        "replicas")
+    p.add_argument("--no-respawn", action="store_true",
+                   help="do not respawn dead spawned replicas")
+    p.add_argument("--respawn-delay-s", type=float, default=1.0)
+    p.add_argument("--poll-interval", type=float, default=1.0,
+                   help="health-poll cadence (seconds)")
+    p.add_argument("--fleet-timeout-s", type=float, default=30.0,
+                   help="default per-request deadline at the fleet edge")
+    p.add_argument("--canary-url", default=None,
+                   help="attach a running replica as the canary")
+    p.add_argument("--canary-export-dir", default=None,
+                   help="spawn the canary from this export directory")
+    p.add_argument("--canary-stub-delay-ms", type=float, default=None,
+                   help="spawn a stub-engine canary with this dispatch "
+                        "delay (chaos harness: an injectably-slow canary)")
+    p.add_argument("--canary-weight", type=float, default=0.25)
+    p.add_argument("--canary-p99-factor", type=float, default=1.5)
+    p.add_argument("--canary-for-s", type=float, default=5.0)
+    p.add_argument("--canary-poll-s", type=float, default=1.0)
+    return p
+
+
+def main(argv: list[str] | None = None) -> dict:
+    import signal
+
+    from batchai_retinanet_horovod_coco_tpu.serve.replica import (
+        HttpReplica,
+        spawn_http_replica,
+    )
+
+    args = build_parser().parse_args(argv)
+    if args.spawn and not (args.export_dir or args.stub_engine):
+        raise SystemExit("--spawn needs --export-dir or --stub-engine")
+
+    replicas: list = [HttpReplica(url) for url in args.replica]
+    procs: dict[str, tuple] = {}  # replica_id -> (proc, port, kwargs)
+
+    def spawn_one(rid: str, port: int | None = None):
+        proc, rep = spawn_http_replica(
+            rid, port=port,
+            export_dir=args.export_dir,
+            stub_delay_ms=args.stub_delay_ms if args.stub_engine else None,
+        )
+        port = int(rep.base_url.rsplit(":", 1)[1])
+        procs[rid] = (proc, port)
+        print(json.dumps({
+            "event": "fleet_replica_spawned",
+            "replica_id": rid, "pid": proc.pid, "port": port,
+        }), flush=True)
+        return rep
+
+    for k in range(args.spawn):
+        replicas.append(spawn_one(f"replica-{k}"))
+    if not replicas:
+        raise SystemExit("no replicas: pass --replica and/or --spawn")
+
+    config = FleetConfig(
+        poll_interval_s=args.poll_interval,
+        default_timeout_s=args.fleet_timeout_s,
+        canary_weight=args.canary_weight,
+        canary_p99_factor=args.canary_p99_factor,
+        canary_for_s=args.canary_for_s,
+        canary_poll_s=args.canary_poll_s,
+    )
+    router = FleetRouter(replicas, config)
+
+    canary_proc = None
+    if args.canary_url or args.canary_export_dir or (
+        args.canary_stub_delay_ms is not None
+    ):
+        if args.canary_url:
+            canary = HttpReplica(args.canary_url, replica_id="canary")
+        else:
+            canary_proc, canary = spawn_http_replica(
+                "canary",
+                export_dir=args.canary_export_dir,
+                stub_delay_ms=args.canary_stub_delay_ms,
+            )
+            print(json.dumps({
+                "event": "fleet_replica_spawned",
+                "replica_id": "canary", "pid": canary_proc.pid,
+                "port": int(canary.base_url.rsplit(":", 1)[1]),
+            }), flush=True)
+        router.add_canary(canary, start_monitor=True)
+
+    stop_supervising = threading.Event()
+
+    def supervise(hb: watchdog.Heartbeat) -> None:
+        """Respawn dead spawned replicas in place (same id, same port) so
+        the breaker's half-open probe readmits them."""
+        try:
+            while not stop_supervising.wait(args.respawn_delay_s):
+                hb.beat()
+                for rid, (proc, port) in list(procs.items()):
+                    if proc.poll() is None:
+                        continue
+                    print(json.dumps({
+                        "event": "fleet_replica_died",
+                        "replica_id": rid, "rc": proc.returncode,
+                    }), flush=True)
+                    try:
+                        new_proc, _rep = spawn_http_replica(
+                            rid, port=port,
+                            export_dir=args.export_dir,
+                            stub_delay_ms=(
+                                args.stub_delay_ms
+                                if args.stub_engine else None
+                            ),
+                        )
+                    except Exception as exc:
+                        print(json.dumps({
+                            "event": "fleet_respawn_failed",
+                            "replica_id": rid, "error": repr(exc),
+                        }), flush=True)
+                        continue
+                    procs[rid] = (new_proc, port)
+                    print(json.dumps({
+                        "event": "fleet_replica_respawned",
+                        "replica_id": rid, "pid": new_proc.pid,
+                        "port": port,
+                    }), flush=True)
+        except BaseException as e:
+            # Crash channel: a silently-dead supervisor means no respawns.
+            print(json.dumps({
+                "event": "fleet_supervisor_crashed", "error": repr(e),
+            }), file=sys.stderr, flush=True)
+            raise
+        finally:
+            hb.close()
+
+    supervisor = None
+    if procs and not args.no_respawn:
+        hb = watchdog.register("fleet-supervisor")
+        supervisor = threading.Thread(
+            target=supervise, args=(hb,), daemon=True,
+            name="fleet-supervisor",
+        )
+        supervisor.start()
+
+    httpd = serve_fleet_http(
+        router, args.host, args.http,
+        request_timeout_s=args.fleet_timeout_s,
+    )
+    print(
+        f"fleet serving on http://{httpd.server_address[0]}:"
+        f"{httpd.server_address[1]} (POST /detect; GET /healthz /metrics "
+        "/fleet)",
+        flush=True,
+    )
+
+    def on_sigterm(_signum, _frame):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, on_sigterm)
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        stop_supervising.set()
+        if supervisor is not None:
+            supervisor.join(timeout=10)
+        httpd.shutdown()
+        httpd.server_close()
+        router.close()
+        for rid, (proc, _port) in procs.items():
+            if proc.poll() is None:
+                proc.terminate()
+                try:
+                    proc.wait(timeout=10)
+                except Exception:
+                    proc.kill()
+        if canary_proc is not None and canary_proc.poll() is None:
+            canary_proc.terminate()
+            try:
+                canary_proc.wait(timeout=10)
+            except Exception:
+                canary_proc.kill()
+    status = router.status()
+    print(json.dumps({"fleet_stats": status["stats"]}), flush=True)
+    return status
+
+
+if __name__ == "__main__":
+    main()

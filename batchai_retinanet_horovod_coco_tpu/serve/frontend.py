@@ -90,10 +90,21 @@ class DetectionServer:
         config: ServeConfig = ServeConfig(),
         sink: Any = None,
         warmup: bool = True,
+        replica_id: str | None = None,
     ):
         self.engine = engine
         self.config = config
         self.sink = sink
+        # Stable identity for the fleet router / canary gate (ISSUE 12):
+        # explicit (the fleet CLI pins it across restarts so the breaker
+        # can re-admit "the same" replica), else host-pid — stable for
+        # the server's lifetime, unique across a host's replicas.
+        if replica_id is None:
+            import os
+            import socket
+
+            replica_id = f"{socket.gethostname()}-{os.getpid()}"
+        self.replica_id = replica_id
         self.stats = LatencyStats(window=config.latency_window)
         # The live-telemetry registry (ISSUE 9): pull-only — quantiles
         # read the LatencyStats window and the collector reads the same
@@ -261,6 +272,11 @@ class DetectionServer:
         queue depths vs bounds, and the windowed p99."""
         snap = self.snapshot()
         return {
+            # Identity first (ISSUE 12): without these the fleet router
+            # cannot attribute health, and the canary gate cannot tell
+            # which export version a p99 regression belongs to.
+            "replica_id": self.replica_id,
+            "version": getattr(self.engine, "version", "live"),
             "inflight": snap["outstanding"],
             "admission_qsize": snap["admission_qsize"],
             "admission_capacity": max(1, self.config.admission_queue),
@@ -531,9 +547,18 @@ def build_parser():
         description="Serve an exported detector (convert_model.py output) "
                     "over HTTP, or run it over a directory of images.",
     )
-    p.add_argument("--export-dir", required=True,
+    p.add_argument("--export-dir", default=None,
                    help="export directory (manifest.json + .stablehlo "
-                        "artifacts) from convert_model.py")
+                        "artifacts) from convert_model.py; required "
+                        "unless --stub-engine")
+    p.add_argument("--stub-engine", action="store_true",
+                   help="serve the stub engine instead of an export: no "
+                        "device work, one fixed detection per request — "
+                        "the fleet smoke / chaos harness replica "
+                        "(serve/stub.py)")
+    p.add_argument("--stub-delay-ms", type=float, default=0.0,
+                   help="stub engine per-dispatch delay (simulated "
+                        "device time; lets harnesses shape p99)")
     mode = p.add_mutually_exclusive_group(required=True)
     mode.add_argument("--http", type=int, metavar="PORT",
                       help="start the HTTP frontend on this port "
@@ -570,11 +595,21 @@ def main(argv: list[str] | None = None) -> dict:
     )
 
     obs_dir = configure_obs(args, process_label="serve")
-    engine = DetectEngine.from_export(args.export_dir)
+    if args.stub_engine:
+        from batchai_retinanet_horovod_coco_tpu.serve.stub import (
+            StubDetectEngine,
+        )
+
+        engine = StubDetectEngine(delay_s=args.stub_delay_ms / 1e3)
+    elif args.export_dir is None:
+        raise SystemExit("--export-dir is required (or pass --stub-engine)")
+    else:
+        engine = DetectEngine.from_export(args.export_dir)
     print(
         f"engine: buckets={engine.buckets} "
         f"batch_sizes={ {hw: engine.batch_sizes(hw) for hw in engine.buckets} } "
-        f"resize={engine.min_side}/{engine.max_side}"
+        f"resize={engine.min_side}/{engine.max_side} "
+        f"version={getattr(engine, 'version', 'live')}"
     )
     sink = None
     if obs_dir is not None:
@@ -584,7 +619,10 @@ def main(argv: list[str] | None = None) -> dict:
 
         sink = EventSink(obs_dir, run_config=vars(args))
         watchdog.default().sink = sink
-    server = DetectionServer(engine, make_serve_config(args), sink=sink)
+    server = DetectionServer(
+        engine, make_serve_config(args), sink=sink,
+        replica_id=getattr(args, "replica_id", None),
+    )
     slo_monitor = None
     status_server = None
     try:

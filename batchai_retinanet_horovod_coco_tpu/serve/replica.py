@@ -1,0 +1,295 @@
+"""Replica handles: one uniform surface over in-process and remote engines.
+
+The fleet router (serve/fleet.py) speaks to every replica through this
+interface — it never cares whether the ``DetectionServer`` lives in this
+process (N engines across local devices) or behind the serve CLI's HTTP
+frontend on another host:
+
+- ``replica_id`` / ``version`` — stable identity (ISSUE 12 satellite:
+  the router and canary gate attribute health and weight by it; the
+  fields ride in every ``/healthz`` 200 payload's ``load`` block);
+- ``healthz()`` — ``(status_code, payload)``; anything but 200 is a
+  breaker signal.  Network failure is reported as code 0 (the poller
+  treats it like a 503, it must never raise out of the poll loop);
+- ``detect(payload, timeout_s)`` — one blocking request.  The error
+  taxonomy is the serve frontend's (``RequestRejected`` with a reason,
+  ``RequestTimeout``) plus ``ReplicaUnavailable`` for "this replica is
+  dead/unreachable" — the one case the router may re-dispatch once.
+
+``spawn_http_replica`` is the subprocess-per-host constructor: it forks
+the existing serve CLI (``python -m …serve``) on a pinned port and waits
+for its ``/healthz`` with the shared backoff policy — the chaos serve
+leg and ``make fleet-smoke`` build their fleets with it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import urllib.error
+import urllib.request
+
+from batchai_retinanet_horovod_coco_tpu.obs import telemetry
+from batchai_retinanet_horovod_coco_tpu.serve.common import (
+    RequestRejected,
+    RequestTimeout,
+    ServeError,
+    ServerClosed,
+    ServerError,
+)
+from batchai_retinanet_horovod_coco_tpu.utils.backoff import BackoffPolicy
+
+
+class ReplicaUnavailable(ServeError):
+    """This replica cannot take the request (dead process, refused
+    connection, crashed worker).  The error class that opens the
+    breaker IMMEDIATELY on the request path and triggers re-dispatch.
+    (A replica-level shed is also retried once on another replica —
+    but it only trips the breaker after a consecutive run, and a
+    timeout is a request outcome, never a replica death.)"""
+
+
+class LocalReplica:
+    """A ``DetectionServer`` in this process.
+
+    ``healthz`` mirrors the HTTP frontend's verdict: the process-wide
+    watchdog verdict (all in-process replicas share one process, hence
+    one watchdog), 503 when this server has crashed or stopped
+    accepting, and the server's ``load_fields()`` (replica_id, version,
+    queue depths, p99) as the ``load`` block either way.
+    """
+
+    def __init__(self, server):
+        self._server = server
+
+    @property
+    def server(self):
+        return self._server
+
+    @property
+    def replica_id(self) -> str:
+        return self._server.replica_id
+
+    @property
+    def version(self) -> str:
+        return getattr(self._server.engine, "version", "live")
+
+    def healthz(self) -> tuple[int, dict]:
+        load = self._server.load_fields()
+        if self._server._error is not None:
+            return 503, {"status": "crashed", "load": load}
+        if not load.get("accepting", False):
+            return 503, {"status": "draining", "load": load}
+        code, payload = telemetry.healthz()
+        payload["load"] = load
+        return code, payload
+
+    def detect(self, payload, timeout_s: float | None = None) -> list[dict]:
+        try:
+            fut = self._server.submit(payload, timeout_s=timeout_s)
+            return fut.result(timeout=timeout_s)
+        except (ServerClosed, ServerError) as exc:
+            raise ReplicaUnavailable(
+                f"replica {self.replica_id} unavailable: {exc}"
+            ) from exc
+        except TimeoutError as exc:  # future wait expired
+            raise RequestTimeout(str(exc)) from exc
+
+    def drain(self, timeout_s: float = 5.0) -> None:
+        """Stop accepting, let in-flight finish (bounded) — the canary
+        rollback path.  Further submits shed with ``shutting_down``."""
+        self._server.close(drain=True, timeout_s=timeout_s)
+
+    def close(self) -> None:
+        self._server.close(drain=False)
+
+
+class HttpReplica:
+    """A replica behind the serve CLI's HTTP frontend (subprocess/host).
+
+    Identity is learned from the first healthy ``/healthz`` payload
+    (its ``load.replica_id`` / ``load.version`` fields) and kept stable
+    afterwards; until then the constructor-provided fallbacks hold.
+    """
+
+    def __init__(
+        self,
+        base_url: str,
+        replica_id: str | None = None,
+        version: str = "unknown",
+        timeout_s: float = 10.0,
+        health_timeout_s: float = 2.5,
+    ):
+        self.base_url = base_url.rstrip("/")
+        self._replica_id = replica_id or self.base_url
+        self._version = version
+        self._timeout_s = timeout_s
+        # Health probes get a TIGHTER bound than requests: the fleet
+        # poller sweeps replicas serially, so one black-holed host must
+        # not starve the whole fleet's weight updates for timeout_s.
+        self._health_timeout_s = min(health_timeout_s, timeout_s)
+
+    @property
+    def replica_id(self) -> str:
+        return self._replica_id
+
+    @property
+    def version(self) -> str:
+        return self._version
+
+    def healthz(self) -> tuple[int, dict]:
+        try:
+            with urllib.request.urlopen(
+                f"{self.base_url}/healthz", timeout=self._health_timeout_s
+            ) as r:
+                payload = json.loads(r.read().decode())
+                code = r.status
+        except urllib.error.HTTPError as e:  # 503 is data, not an error
+            try:
+                payload = json.loads(e.read().decode())
+            except Exception:
+                payload = {}
+            code = e.code
+        except Exception as e:  # refused/reset/timeout — poller signal
+            return 0, {"status": "unreachable", "error": repr(e)}
+        load = payload.get("load") or {}
+        if code == 200 and load.get("replica_id"):
+            self._replica_id = str(load["replica_id"])
+            self._version = str(load.get("version") or self._version)
+        return code, payload
+
+    def detect(self, payload, timeout_s: float | None = None) -> list[dict]:
+        if not isinstance(payload, (bytes, bytearray, memoryview)):
+            raise RequestRejected(
+                "decode_error", "HTTP replicas take encoded image bytes"
+            )
+        req = urllib.request.Request(
+            f"{self.base_url}/detect", data=bytes(payload), method="POST"
+        )
+        timeout = self._timeout_s if timeout_s is None else timeout_s
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return json.loads(r.read().decode())["detections"]
+        except urllib.error.HTTPError as e:
+            body = {}
+            try:
+                body = json.loads(e.read().decode())
+            except Exception:
+                pass
+            if e.code in (400, 503):
+                raise RequestRejected(
+                    str(body.get("reason", "rejected"))
+                ) from e
+            if e.code == 504:
+                raise RequestTimeout("replica deadline exceeded") from e
+            raise ReplicaUnavailable(
+                f"replica {self.replica_id} HTTP {e.code}"
+            ) from e
+        except Exception as e:
+            # A socket timeout is a SLOW replica, not a dead one: the
+            # request ran out of time (a request outcome — never a
+            # breaker hit, never re-dispatched while the original may
+            # still be executing).  Refused/reset = actually dead.
+            if isinstance(e, TimeoutError) or isinstance(
+                getattr(e, "reason", None), TimeoutError
+            ):
+                raise RequestTimeout(
+                    f"replica {self.replica_id} timed out"
+                ) from e
+            raise ReplicaUnavailable(
+                f"replica {self.replica_id} unreachable: {e!r}"
+            ) from e
+
+    def drain(self, timeout_s: float = 5.0) -> None:
+        # No remote admin surface: "drain" for an HTTP replica is the
+        # router holding its weight at zero while in-flight work on the
+        # replica finishes under the frontend's own drain contract.
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+def free_port(host: str = "127.0.0.1") -> int:
+    """An OS-assigned free TCP port (bind-0 probe).  Small race window
+    between close and the child's bind — acceptable for smoke harnesses,
+    which retry the spawn on a failed health wait."""
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def spawn_http_replica(
+    replica_id: str,
+    port: int | None = None,
+    host: str = "127.0.0.1",
+    export_dir: str | None = None,
+    stub_delay_ms: float | None = None,
+    extra_args: list[str] | None = None,
+    wait_policy: BackoffPolicy = BackoffPolicy(
+        max_tries=120, base_s=0.5, multiplier=1.0, jitter=0.0
+    ),
+    env: dict | None = None,
+) -> tuple[subprocess.Popen, "HttpReplica"]:
+    """Fork one serve-CLI replica on a pinned port and wait for health.
+
+    ``export_dir=None`` spawns a ``--stub-engine`` replica (the fleet
+    smoke / chaos legs); the pinned port is what lets a breaker-open
+    replica be RESTARTED in place and readmitted by the half-open probe.
+    Returns ``(process, HttpReplica)``; the caller owns the process.
+    """
+    port = free_port(host) if port is None else port
+    cmd = [
+        sys.executable, "-m", "batchai_retinanet_horovod_coco_tpu.serve",
+        "--http", str(port), "--host", host, "--replica-id", replica_id,
+    ]
+    if export_dir is not None:
+        cmd += ["--export-dir", export_dir]
+    else:
+        cmd += ["--stub-engine"]
+        if stub_delay_ms is not None:
+            cmd += ["--stub-delay-ms", str(stub_delay_ms)]
+    cmd += extra_args or []
+    child_env = dict(os.environ)
+    child_env.setdefault("JAX_PLATFORMS", "cpu")
+    # The repo is path-based (not pip-installed): make sure the child
+    # resolves the package no matter the caller's cwd.
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+    child_env["PYTHONPATH"] = (
+        repo_root + os.pathsep + child_env["PYTHONPATH"]
+        if child_env.get("PYTHONPATH") else repo_root
+    )
+    child_env.update(env or {})
+    proc = subprocess.Popen(
+        cmd, env=child_env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    replica = HttpReplica(f"http://{host}:{port}", replica_id=replica_id)
+
+    def probe():
+        if proc.poll() is not None:
+            return f"replica process exited rc={proc.returncode}"
+        code, _payload = replica.healthz()
+        return None if code == 200 else f"healthz {code}"
+
+    _attempts, err = wait_policy.retry(probe)
+    if err is not None:
+        proc.kill()
+        raise ReplicaUnavailable(
+            f"spawned replica {replica_id} never became healthy: {err}"
+        )
+    return proc, replica
+
+
+__all__ = [
+    "HttpReplica",
+    "LocalReplica",
+    "ReplicaUnavailable",
+    "free_port",
+    "spawn_http_replica",
+]

@@ -1,0 +1,101 @@
+"""The canonical stub engine: serve machinery without device work.
+
+One fixed detection per batch row, an optional per-dispatch delay, and a
+record of dispatched batch sizes — everything the queue/batcher/frontend
+machinery needs to run for real while the "device" costs nothing.  It
+existed as two drifting private copies (tests/unit/test_serve.py and
+scripts/telemetry_smoke.py) before the fleet work (ISSUE 12) needed a
+THIRD: subprocess stub replicas for ``make fleet-smoke`` and the chaos
+serve leg (``python -m …serve --stub-engine``).  Now there is one.
+
+The fixed detection round-trips ``detections_to_coco`` exactly:
+``EXPECTED_DETECTIONS`` is what any 64x64 request served through a stub
+engine must come back as — the assertion constant for every consumer.
+"""
+
+from __future__ import annotations
+
+import time  # lint-exempt rationale below: injected dispatch delay only
+
+import numpy as np
+
+from batchai_retinanet_horovod_coco_tpu.serve.engine import IdentityLabelMap
+
+
+class StubDetections:
+    """Duck-typed Detections (boxes/scores/labels/valid attrs)."""
+
+    def __init__(self, boxes, scores, labels, valid):
+        self.boxes, self.scores, self.labels = boxes, scores, labels
+        self.valid = valid
+
+
+#: What one stub-served 64x64 request resolves to, after the shared
+#: ``detections_to_coco`` conversion (xyxy → xywh, clamped).
+EXPECTED_DETECTIONS = [
+    {"category_id": 0, "bbox": [1.0, 2.0, 9.0, 18.0], "score": 0.5}
+]
+
+
+class StubDetectEngine:
+    """One fixed detection per row; records dispatched batch sizes.
+
+    ``delay_s`` makes the "device" slow enough that bounded queues shed
+    under an open-loop flood (the telemetry smoke's requirement) or that
+    a canary's p99 visibly regresses (the fleet chaos leg's requirement).
+    """
+
+    min_side = 64
+    max_side = 64
+    buckets = ((64, 64),)
+    label_to_cat_id = IdentityLabelMap()
+    source = "stub"
+
+    def __init__(
+        self,
+        batch_sizes: tuple[int, ...] = (4,),
+        delay_s: float = 0.0,
+        version: str = "stub",
+    ):
+        self._sizes = sorted(batch_sizes)
+        self.delay_s = delay_s
+        self.version = version
+        self.dispatched: list[int] = []
+
+    def batch_sizes(self, hw):
+        return list(self._sizes)
+
+    def max_batch(self, hw):
+        return self._sizes[-1]
+
+    def batch_size_for(self, hw, n):
+        for b in self._sizes:
+            if b >= n:
+                return b
+        return self._sizes[-1]
+
+    def warmup(self):
+        pass
+
+    def dispatch(self, hw, images):
+        if self.delay_s:
+            # The injected "device time" — a plain sleep, deliberately
+            # not the obs clock (nothing here is a timestamp).
+            time.sleep(self.delay_s)
+        b = images.shape[0]
+        self.dispatched.append(b)
+        boxes = np.tile(
+            np.array([[[1.0, 2.0, 10.0, 20.0]]], np.float32), (b, 1, 1)
+        )
+        return StubDetections(
+            boxes,
+            np.full((b, 1), 0.5, np.float32),
+            np.zeros((b, 1), np.int32),
+            np.ones((b, 1), bool),
+        )
+
+    def fetch(self, det):
+        return det
+
+
+__all__ = ["EXPECTED_DETECTIONS", "StubDetectEngine", "StubDetections"]

@@ -210,6 +210,12 @@ def add_serve_flags(parser) -> None:
                         help="graceful close() waits this long for "
                              "in-flight requests before rejecting the "
                              "remainder")
+    parser.add_argument("--replica-id", default=None,
+                        help="stable identity carried in /healthz load "
+                             "fields (fleet routing / canary attribution "
+                             "— ISSUE 12); default host-pid.  The fleet "
+                             "CLI pins it across restarts so a breaker-"
+                             "open replica is re-admitted as itself")
 
 
 def make_serve_config(args):

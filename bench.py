@@ -122,22 +122,23 @@ def probe_device() -> tuple[int, str | None]:
     Env knobs (the unit test shrinks them): BENCH_PROBE_ATTEMPTS (3),
     BENCH_PROBE_TIMEOUT_S (120), BENCH_PROBE_BACKOFF_S ("10,30" — seconds
     slept between attempts, last value reused if attempts exceed it).
+
+    The retry loop this function grew is now utils/backoff.py's
+    ``BackoffPolicy`` (ISSUE 12 satellite — the fleet router's health
+    poller and re-dispatch path share the exact same schedule machinery);
+    the import is jax-free (stdlib + the lazy utils package), so it's
+    safe in this above-the-heavy-imports section.
     """
+    from batchai_retinanet_horovod_coco_tpu.utils.backoff import (
+        BackoffPolicy,
+    )
+
     attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", "3"))
     timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT_S", "120"))
-    backoff = [
-        float(x)
-        for x in os.environ.get("BENCH_PROBE_BACKOFF_S", "10,30").split(",")
-        if x.strip()
-    ] or [10.0]
-    last_error: str | None = None
-    for i in range(max(1, attempts)):
-        last_error = _probe_once(timeout_s)
-        if last_error is None:
-            return i + 1, None
-        if i + 1 < attempts:
-            time.sleep(backoff[min(i, len(backoff) - 1)])
-    return max(1, attempts), last_error
+    policy = BackoffPolicy.from_env_schedule(
+        attempts, os.environ.get("BENCH_PROBE_BACKOFF_S", "10,30")
+    )
+    return policy.retry(lambda: _probe_once(timeout_s))
 
 
 _UNAVAILABLE_MARKERS = (
@@ -1230,10 +1231,215 @@ def run_serve_bucket(
     return out
 
 
-def check_serve_against_committed(value: float, device_kind: str) -> int:
+# ---------------------------------------------------------------------------
+# Fleet availability leg (ISSUE 12): real fleet machinery, stub replicas
+# ---------------------------------------------------------------------------
+
+
+def run_fleet_leg() -> dict:
+    """Kill-a-replica availability + canary rollback on the REAL fleet
+    router (serve/fleet.py) over in-process stub replicas.
+
+    No device work at all — the measurand is the ROUTER's mechanics
+    (availability under replica death, bounded re-dispatch, exactly-once
+    canary rollback), which are device-independent, so the leg runs
+    identically on the chip and on a CPU check box.  The contract the
+    committed ``fleet`` fields pin: every submitted request RESOLVES
+    (availability 1.0 — completes or sheds with a reason, zero hangs),
+    and post-kill completion stays at or above the surviving capacity
+    share ((N-1)/N).
+    """
+    import threading
+
+    import numpy as np
+
+    from batchai_retinanet_horovod_coco_tpu.serve import (
+        DetectionServer,
+        FleetConfig,
+        FleetRouter,
+        LocalReplica,
+        RequestRejected,
+        RequestTimeout,
+        ServeConfig,
+        ServeError,
+    )
+    from batchai_retinanet_horovod_coco_tpu.serve.stub import (
+        StubDetectEngine,
+    )
+
+    n_replicas = 3
+    servers = [
+        DetectionServer(
+            StubDetectEngine(delay_s=0.01),
+            ServeConfig(max_delay_ms=2.0, preprocess_workers=1),
+            replica_id=f"bench-r{i}",
+        )
+        for i in range(n_replicas)
+    ]
+    router = FleetRouter(
+        [LocalReplica(s) for s in servers],
+        FleetConfig(
+            poll_interval_s=0.05, default_timeout_s=20.0,
+            canary_weight=0.5, canary_p99_factor=3.0,
+            canary_for_s=0.2, canary_poll_s=0.05,
+        ),
+    )
+    img = np.zeros((64, 64, 3), np.uint8)
+    total, clients = 120, 4
+    kill_at = total // 2
+    lock = threading.Lock()
+    counts = {"ok": 0, "shed": 0, "timeout": 0, "failed": 0}
+    post_kill = {"ok": 0, "total": 0}
+    issued = [0]
+    killed = [False]
+
+    def client():
+        try:
+            while True:
+                with lock:
+                    if issued[0] >= total:
+                        return
+                    issued[0] += 1
+                    fire = issued[0] == kill_at and not killed[0]
+                    if fire:
+                        killed[0] = True
+                if fire:
+                    # The in-process SIGKILL equivalent: the victim's
+                    # threads stop and every subsequent submit raises —
+                    # the router must breaker it and re-dispatch.
+                    servers[0].close(drain=False)
+                try:
+                    router.detect(img)
+                    out = "ok"
+                except RequestRejected:
+                    out = "shed"
+                except RequestTimeout:
+                    out = "timeout"
+                except ServeError:
+                    out = "failed"
+                with lock:
+                    counts[out] += 1
+                    if killed[0]:
+                        post_kill["total"] += 1
+                        post_kill["ok"] += out == "ok"
+        except Exception as e:  # crash channel: an unresolved request
+            print(f"# fleet leg client crashed: {e!r}", flush=True)
+            raise
+
+    # watchdog: bench-local load generators, bounded by the join below.
+    threads = [
+        threading.Thread(target=client, daemon=True) for _ in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+
+    # Canary micro-leg: a visibly slow canary joins; its monitor (real
+    # poll thread, aggressive cadence) must fire exactly one rollback.
+    # 250 ms of injected device time: the serve stack's light-load
+    # latency floor (~60 ms — the dispatcher's idle-flush poll) would
+    # mask a smaller regression under the 3x ratio gate.
+    canary_server = DetectionServer(
+        StubDetectEngine(delay_s=0.25),
+        ServeConfig(max_delay_ms=2.0, preprocess_workers=1),
+        replica_id="bench-canary",
+    )
+    router.add_canary(LocalReplica(canary_server), start_monitor=True)
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        try:
+            router.detect(img)
+        except ServeError:
+            pass
+        if router.status()["canary_rollbacks"] >= 1:
+            break
+    status = router.status()
+    router.close()
+    for s in servers:
+        s.close(drain=False)
+    canary_server.close(drain=False)
+
+    resolved = sum(counts.values())
+    return {
+        "replicas": n_replicas,
+        "requests": issued[0],
+        "completed": counts["ok"],
+        "shed": counts["shed"],
+        "timeout": counts["timeout"],
+        "failed": counts["failed"],
+        "unresolved": issued[0] - resolved,
+        # THE availability claim: 1.0 = every request completed or shed
+        # with a reason — nothing hung, nothing silently dropped.
+        "availability": round(resolved / max(1, issued[0]), 4),
+        "post_kill_ok_ratio": round(
+            post_kill["ok"] / max(1, post_kill["total"]), 4
+        ),
+        "capacity_share_floor": round((n_replicas - 1) / n_replicas, 4),
+        "redispatches": status["redispatches"],
+        "breaker_opens": status["breaker_opens"],
+        "canary_rollbacks": status["canary_rollbacks"],
+    }
+
+
+def check_fleet_against_committed(fresh: dict | None) -> int:
+    """The fleet half of servebench-check.  Device-class guard does not
+    apply: the leg is stub-based and device-independent, so the bands
+    hold everywhere — availability is an exact contract (1.0), post-kill
+    completion must clear the (N-1)/N capacity-share floor, and the
+    canary gate must have fired exactly once."""
+    try:
+        with open(_artifact_path("SERVEBENCH.json")) as f:
+            committed = json.load(f).get("fleet")
+    except (OSError, ValueError) as e:
+        print(f"# servebench-check[fleet]: cannot read baseline: {e}")
+        return 1
+    if committed is None:
+        print("# servebench-check[fleet]: committed SERVEBENCH.json has no "
+              "fleet record yet — re-capture with `make servebench`")
+        return 0
+    if fresh is None:
+        print("# servebench-check[fleet]: fleet leg disabled "
+              "(SERVEBENCH_FLEET=0) — the committed fleet record goes "
+              "UNCHECKED this run; re-enable it for the real tripwire")
+        return 0
+    rc = 0
+    if fresh["availability"] < float(committed.get("availability", 1.0)):
+        print(
+            f"# servebench-check[fleet]: availability regressed "
+            f"{committed.get('availability')} -> {fresh['availability']} "
+            "(requests hung or were silently dropped): REGRESSION"
+        )
+        rc = 1
+    floor = float(committed.get("capacity_share_floor", 2 / 3))
+    if fresh["post_kill_ok_ratio"] < floor:
+        print(
+            f"# servebench-check[fleet]: post-kill completion "
+            f"{fresh['post_kill_ok_ratio']} below the (N-1)/N capacity "
+            f"share {floor}: REGRESSION"
+        )
+        rc = 1
+    if fresh["canary_rollbacks"] != 1:
+        print(
+            f"# servebench-check[fleet]: expected exactly 1 canary "
+            f"rollback, measured {fresh['canary_rollbacks']}: REGRESSION"
+        )
+        rc = 1
+    if rc == 0:
+        print(
+            f"# servebench-check[fleet]: availability "
+            f"{fresh['availability']}, post-kill {fresh['post_kill_ok_ratio']}"
+            f" >= {floor}, canary rollbacks 1: ok"
+        )
+    return rc
+
+
+def check_serve_against_committed(
+    value: float, device_kind: str, fleet: dict | None = None
+) -> int:
     """servebench-check: fresh flagship closed-loop SERVE rate vs the
     committed SERVEBENCH.json — same floor/device policy as bench-check
-    (``_check_floor``)."""
+    (``_check_floor``) — plus the fleet availability band (ISSUE 12)."""
     try:
         with open(_artifact_path("SERVEBENCH.json")) as f:
             committed = json.load(f)
@@ -1241,13 +1447,14 @@ def check_serve_against_committed(value: float, device_kind: str) -> int:
     except (OSError, KeyError, ValueError) as e:
         print(f"# servebench-check: cannot read committed baseline: {e}")
         return 1
-    return _check_floor(
+    rc = _check_floor(
         "servebench-check",
         value,
         committed_value,
         str(committed.get("device_kind", "")) or None,
         device_kind,
     )
+    return max(rc, check_fleet_against_committed(fleet))
 
 
 def run_serve_mode() -> None:
@@ -1288,13 +1495,21 @@ def run_serve_mode() -> None:
         "measure_steps": measure_steps,
         "per_bucket": per_bucket,
     }
+    # Fleet availability leg (ISSUE 12): stub-based (device-independent),
+    # cheap — on by default; SERVEBENCH_FLEET=0 skips it.
+    fleet = None
+    if os.environ.get("SERVEBENCH_FLEET", "1") not in ("", "0"):
+        fleet = run_fleet_leg()
+        out["fleet"] = fleet
     att = _trace_attribution()
     if att is not None:
         out["attribution"] = att
     print(json.dumps(out), flush=True)
 
     if os.environ.get("BENCH_CHECK", "") not in ("", "0"):
-        raise SystemExit(check_serve_against_committed(value, device_kind))
+        raise SystemExit(
+            check_serve_against_committed(value, device_kind, fleet)
+        )
 
 
 def run_train_mode() -> None:

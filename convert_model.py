@@ -62,6 +62,11 @@ def build_parser() -> argparse.ArgumentParser:
     from batchai_retinanet_horovod_coco_tpu.utils.cli import add_anchor_flags
 
     add_anchor_flags(p)
+    p.add_argument("--export-version", default=None, metavar="VERSION",
+                   help="rollout identity recorded in the manifest (the "
+                        "serve fleet's router/canary gate attributes "
+                        "per-replica health by it; default: the export "
+                        "directory's basename at load time)")
     p.add_argument("--platforms", default=None,
                    help="comma-separated lowering targets, e.g. cpu,tpu "
                         "(default: the current backend only)")
@@ -183,6 +188,7 @@ def main(argv: list[str] | None = None) -> str:
         platforms=platforms,
         image_min_side=args.image_min_side,
         image_max_side=args.image_max_side,
+        version=args.export_version,
     )
     sizes = {
         e: os.path.getsize(os.path.join(args.output, e))

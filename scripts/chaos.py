@@ -341,6 +341,416 @@ def _nan_leg(steps: int = 12, inject_at: int = 7) -> None:
 
 
 # ---------------------------------------------------------------------------
+# Serve fleet leg (ISSUE 12): kill-a-replica + SLO-gated canary rollback
+# ---------------------------------------------------------------------------
+#
+# System under test: the REAL fleet CLI (python -m …serve.fleet) over
+# stub-engine replica subprocesses — the serve-side twin of the training
+# kill schedule above.  Two legs:
+#
+# - kill: SIGKILL one replica subprocess mid-load; every accepted request
+#   must complete or shed WITH A REASON (zero hung clients, zero silent
+#   drops), the router's /healthz must stay 200 throughout, and after the
+#   supervisor respawns the replica the breaker must readmit it (traffic
+#   lands on it again).
+# - canary: a deliberately slow stub canary joins behind the canary gate;
+#   the p99 regression must produce EXACTLY ONE canary_rollback event and
+#   leave the fleet at baseline weights, with traffic unharmed.
+
+
+def _fleet_payload() -> bytes:
+    import io
+
+    import numpy as np
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(np.zeros((64, 64, 3), np.uint8)).save(buf, "PNG")
+    return buf.getvalue()
+
+
+def _http_get(url: str, timeout: float = 10.0):
+    """(status, body_bytes); 4xx/5xx are data, socket errors raise."""
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+class _FleetUnderTest:
+    """One fleet-CLI subprocess + line-readers for its structured stdout
+    (spawn/respawn events) and stderr (breaker/canary events)."""
+
+    def __init__(self, tag: str, extra_args: list[str]):
+        import threading
+
+        self.tag = tag
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m",
+             "batchai_retinanet_horovod_coco_tpu.serve.fleet",
+             "--http", "0"] + extra_args,
+            env=env, cwd=_REPO, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        self.stdout_lines: list[str] = []
+        self.stderr_lines: list[str] = []
+
+        def reader(stream, into):
+            try:
+                for line in stream:
+                    into.append(line.rstrip("\n"))
+            except Exception as e:  # crash channel: visible in the report
+                into.append(f"__reader_error__ {e!r}")
+
+        # watchdog: harness-local pipe readers; liveness is witnessed by
+        # the driver's own bounded waits, not the obs watchdog.
+        self._readers = [
+            threading.Thread(
+                target=reader, args=(self.proc.stdout, self.stdout_lines),
+                daemon=True,
+            ),
+            threading.Thread(
+                target=reader, args=(self.proc.stderr, self.stderr_lines),
+                daemon=True,
+            ),
+        ]
+        for t in self._readers:
+            t.start()
+        try:
+            self.base_url = self._wait_for_url()
+        except Exception:
+            # Constructor failure = no handle for the caller's finally:
+            # kill the fleet CLI here (its own teardown reaps the
+            # replica children) so a wedged bring-up can't leak
+            # processes holding pinned ports into the next CI run.
+            self.stop()
+            raise
+
+    def _wait_for_url(self, timeout: float = 180.0) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"{self.tag}: fleet CLI died rc={self.proc.returncode}: "
+                    f"{self.stderr_lines[-5:]}"
+                )
+            for line in self.stdout_lines:
+                if line.startswith("fleet serving on "):
+                    return line.split("fleet serving on ", 1)[1].split()[0]
+            time.sleep(0.1)
+        raise RuntimeError(f"{self.tag}: fleet CLI never started serving")
+
+    def events(self, kind: str) -> list[dict]:
+        out = []
+        for line in self.stdout_lines + self.stderr_lines:
+            try:
+                rec = json.loads(line)
+            except (json.JSONDecodeError, ValueError):
+                continue
+            if isinstance(rec, dict) and rec.get("event") == kind:
+                out.append(rec)
+        return out
+
+    def stop(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=10)
+
+
+def _fleet_storm(
+    base_url: str, payload: bytes, total: int, clients: int,
+    mid_action=None, request_timeout: float = 30.0,
+) -> dict:
+    """Drive ``total`` requests from ``clients`` threads; every request
+    must RESOLVE (2xx/4xx/5xx all count — a hang or router socket error
+    does not).  ``mid_action()`` runs once, halfway through."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    lock = threading.Lock()
+    counts = {"ok": 0, "shed": 0, "timeout": 0, "server_error": 0,
+              "router_unreachable": 0, "hung": 0, "other": 0}
+    issued = [0]
+    acted = [False]
+
+    def one_request():
+        req = urllib.request.Request(
+            f"{base_url}/detect", data=payload, method="POST"
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=request_timeout) as r:
+                json.loads(r.read().decode())
+                return "ok"
+        except urllib.error.HTTPError as e:
+            body = {}
+            try:
+                body = json.loads(e.read().decode())
+            except Exception:
+                pass
+            if e.code == 503:
+                # A shed MUST carry a machine-readable reason.
+                return "shed" if body.get("reason") else "other"
+            if e.code == 504:
+                return "timeout"
+            return "server_error"
+        except TimeoutError:
+            return "hung"  # the contract violation this leg exists for
+        except Exception as e:
+            if "timed out" in str(e).lower():
+                return "hung"
+            return "router_unreachable"
+
+    def client():
+        try:
+            while True:
+                with lock:
+                    if issued[0] >= total:
+                        return
+                    issued[0] += 1
+                    n = issued[0]
+                    fire = n == max(1, total // 2) and not acted[0]
+                    if fire:
+                        acted[0] = True
+                if fire and mid_action is not None:
+                    mid_action()
+                outcome = one_request()
+                with lock:
+                    counts[outcome] += 1
+        except Exception as e:  # crash channel: a dead client = hung reqs
+            with lock:
+                counts["other"] += 1
+            print(f"chaos FAIL: storm client crashed: {e!r}", flush=True)
+
+    # watchdog: harness-local load generators; every request is bounded
+    # by its own urlopen timeout, the driver joins with a budget below.
+    threads = [
+        threading.Thread(target=client, daemon=True)
+        for _ in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=request_timeout * total / max(1, clients) + 60)
+    counts["submitted"] = issued[0]
+    counts["resolved"] = sum(
+        counts[k] for k in ("ok", "shed", "timeout", "server_error")
+    )
+    return counts
+
+
+def _wait_until(predicate, timeout: float, what: str) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if predicate():
+                return True
+        except Exception:
+            pass
+        time.sleep(0.25)
+    check(False, what)
+    return False
+
+
+def _fleet_status(base_url: str) -> dict:
+    code, body = _http_get(f"{base_url}/fleet")
+    return json.loads(body.decode()) if code == 200 else {}
+
+
+def _metric_value(base_url: str, name: str) -> float:
+    sys.path.insert(0, _REPO)
+    try:
+        from batchai_retinanet_horovod_coco_tpu.obs.telemetry import (
+            parse_exposition,
+        )
+    finally:
+        sys.path.pop(0)
+    code, body = _http_get(f"{base_url}/metrics")
+    if code != 200:
+        return float("nan")
+    _types, samples = parse_exposition(body.decode())
+    return samples.get(name, 0.0)
+
+
+def _serve_kill_leg() -> None:
+    """SIGKILL one replica mid-load: zero hangs, zero silent drops,
+    router 200 throughout, breaker reopens after the respawn."""
+    import threading
+
+    fleet = _FleetUnderTest("serve_kill", [
+        "--spawn", "2", "--stub-engine", "--stub-delay-ms", "30",
+        "--poll-interval", "0.2", "--respawn-delay-s", "0.5",
+        "--fleet-timeout-s", "20",
+    ])
+    try:
+        spawned = fleet.events("fleet_replica_spawned")
+        check(len(spawned) == 2, f"expected 2 spawns, saw {len(spawned)}")
+        victim = spawned[0]
+
+        # Router-liveness watcher: /healthz must be 200 THROUGHOUT.
+        bad_healthz: list[tuple] = []
+        stop_watch = threading.Event()
+
+        def watch_healthz():
+            try:
+                while not stop_watch.wait(0.1):
+                    code, _ = _http_get(
+                        f"{fleet.base_url}/healthz", timeout=5
+                    )
+                    if code != 200:
+                        bad_healthz.append((time.monotonic(), code))
+            except Exception as e:  # crash channel → leg fails loudly
+                bad_healthz.append((time.monotonic(), repr(e)))
+
+        # watchdog: harness-local probe loop, bounded by stop_watch below.
+        watcher = threading.Thread(target=watch_healthz, daemon=True)
+        watcher.start()
+
+        counts = _fleet_storm(
+            fleet.base_url, _fleet_payload(), total=60, clients=4,
+            mid_action=lambda: os.kill(victim["pid"], signal.SIGKILL),
+        )
+        stop_watch.set()
+        watcher.join(timeout=10)
+
+        check(counts["hung"] == 0, f"kill leg: hung clients: {counts}")
+        check(
+            counts["router_unreachable"] == 0 and counts["other"] == 0,
+            f"kill leg: router dropped/garbled requests: {counts}",
+        )
+        check(
+            counts["resolved"] == counts["submitted"],
+            f"kill leg: silent drops: {counts}",
+        )
+        check(counts["ok"] > 0, f"kill leg: nothing completed: {counts}")
+        check(
+            not bad_healthz,
+            f"kill leg: router /healthz flapped: {bad_healthz[:5]}",
+        )
+        check(
+            _metric_value(fleet.base_url, "fleet_breaker_open_total") >= 1,
+            "kill leg: breaker never opened on the killed replica",
+        )
+
+        # The supervisor respawns the victim in place; the half-open
+        # probe must readmit it (breaker re-closes).
+        _wait_until(
+            lambda: len(fleet.events("fleet_replica_respawned")) >= 1,
+            60, "kill leg: victim was never respawned",
+        )
+        rid = victim["replica_id"]
+        _wait_until(
+            lambda: any(
+                r["replica_id"] == rid and r["state"] == "closed"
+                for r in _fleet_status(fleet.base_url).get("replicas", [])
+            ),
+            60, "kill leg: breaker never readmitted the respawned replica",
+        )
+        post = _fleet_storm(
+            fleet.base_url, _fleet_payload(), total=8, clients=2
+        )
+        check(
+            post["ok"] == post["submitted"],
+            f"kill leg: post-respawn traffic unhealthy: {post}",
+        )
+        # Fleet metric families exist on the scrape surface.
+        _code, metrics_body = _http_get(f"{fleet.base_url}/metrics")
+        for fam in ("fleet_requests_completed_total", "fleet_replica_weight",
+                    "fleet_request_latency_ms", "fleet_breaker_state"):
+            check(
+                fam.encode() in metrics_body,
+                f"kill leg: {fam} missing from fleet /metrics",
+            )
+    finally:
+        fleet.stop()
+
+
+def _serve_canary_leg() -> None:
+    """An injected-slow canary behind the gate: exactly one
+    canary_rollback, fleet back to baseline weights, traffic unharmed."""
+    fleet = _FleetUnderTest("serve_canary", [
+        "--spawn", "2", "--stub-engine", "--stub-delay-ms", "2",
+        "--canary-stub-delay-ms", "250", "--canary-weight", "0.5",
+        "--canary-p99-factor", "3", "--canary-for-s", "0.5",
+        "--canary-poll-s", "0.2", "--poll-interval", "0.2",
+        "--fleet-timeout-s", "20",
+    ])
+    try:
+        counts = _fleet_storm(
+            fleet.base_url, _fleet_payload(), total=60, clients=4
+        )
+        check(
+            counts["resolved"] == counts["submitted"]
+            and counts["hung"] == 0,
+            f"canary leg: requests lost during rollout: {counts}",
+        )
+        _wait_until(
+            lambda: _metric_value(
+                fleet.base_url, "fleet_canary_rollback_total"
+            ) == 1.0,
+            60, "canary leg: rollback never fired",
+        )
+        # More traffic — the gate must NOT fire again (exactly once).
+        post = _fleet_storm(
+            fleet.base_url, _fleet_payload(), total=20, clients=2
+        )
+        check(
+            post["resolved"] == post["submitted"] and post["hung"] == 0,
+            f"canary leg: post-rollback traffic lost: {post}",
+        )
+        check(
+            _metric_value(
+                fleet.base_url, "fleet_canary_rollback_total"
+            ) == 1.0,
+            "canary leg: canary_rollback fired more than once",
+        )
+        rollbacks = fleet.events("canary_rollback")
+        check(
+            len(rollbacks) == 1,
+            f"canary leg: expected 1 canary_rollback event, saw "
+            f"{len(rollbacks)}",
+        )
+        status = _fleet_status(fleet.base_url)
+        by_id = {r["replica_id"]: r for r in status.get("replicas", [])}
+        check(
+            status.get("canary_outcome") == "rolled_back",
+            f"canary leg: outcome {status.get('canary_outcome')!r}",
+        )
+        check(
+            by_id.get("canary", {}).get("state") == "drained"
+            and by_id.get("canary", {}).get("weight") == 0,
+            f"canary leg: canary not drained: {by_id.get('canary')}",
+        )
+        baseline_ok = all(
+            by_id.get(rid, {}).get("state") == "closed"
+            and by_id.get(rid, {}).get("weight", 0) > 0
+            for rid in ("replica-0", "replica-1")
+        )
+        check(
+            baseline_ok,
+            f"canary leg: fleet not back at baseline weights: {by_id}",
+        )
+    finally:
+        fleet.stop()
+
+
+def run_serve_legs() -> None:
+    """The fleet serve schedule (``make fleet-smoke`` / ``--serve``)."""
+    _serve_kill_leg()
+    _serve_canary_leg()
+
+
+# ---------------------------------------------------------------------------
 # CKPTBENCH
 # ---------------------------------------------------------------------------
 
@@ -520,6 +930,13 @@ def main(argv=None) -> int:
     p.add_argument("--smoke", action="store_true",
                    help="bounded CI leg: one mid-save SIGKILL + one NaN "
                         "auto-resume (make chaos-smoke)")
+    p.add_argument("--serve", action="store_true",
+                   help="serve fleet legs only (make fleet-smoke): "
+                        "SIGKILL one stub replica mid-load behind the "
+                        "fleet router (zero hangs/silent drops, router "
+                        "200s throughout, breaker reopens after respawn) "
+                        "+ the slow-canary rollback leg (exactly one "
+                        "canary_rollback, fleet back to baseline)")
     p.add_argument("--bench", action="store_true",
                    help="CKPTBENCH: save overhead + time-to-first-step")
     p.add_argument("--check", action="store_true",
@@ -539,6 +956,14 @@ def main(argv=None) -> int:
             "failures": _failures,
         }), flush=True)
         return rc
+
+    if args.serve:
+        run_serve_legs()
+        print(json.dumps({
+            "chaos": "ok" if not _failures else "FAIL",
+            "failures": _failures,
+        }), flush=True)
+        return 1 if _failures else 0
 
     steps = args.steps
     baseline_dir, baseline = _baseline(steps)
@@ -568,6 +993,8 @@ def main(argv=None) -> int:
         if not _failures:
             _torn_dir_legs(baseline, steps)
             _nan_leg()
+        if not _failures:
+            run_serve_legs()  # the serve-side half of the full schedule
         print(f"# chaos: {kills} scheduled kills executed", flush=True)
 
     if not _failures:

@@ -44,57 +44,13 @@ from batchai_retinanet_horovod_coco_tpu.serve import (  # noqa: E402
     ServeConfig,
     serve_http,
 )
-from batchai_retinanet_horovod_coco_tpu.serve.engine import (  # noqa: E402
-    IdentityLabelMap,
+# The canonical no-device stub engine (serve/stub.py — ISSUE 12 unified
+# the private copies): a small dispatch delay so an open-loop flood
+# overwhelms the tiny queues and SHEDS (the smoke must see nonzero shed
+# counters, not just latency).
+from batchai_retinanet_horovod_coco_tpu.serve.stub import (  # noqa: E402
+    StubDetectEngine,
 )
-
-
-class _Det:
-    def __init__(self, boxes, scores, labels, valid):
-        self.boxes, self.scores, self.labels = boxes, scores, labels
-        self.valid = valid
-
-
-class StubEngine:
-    """One fixed detection per row; a small dispatch delay so an
-    open-loop flood overwhelms the tiny queues and SHEDS (the smoke must
-    see nonzero shed counters, not just latency)."""
-
-    min_side = 64
-    max_side = 64
-    buckets = ((64, 64),)
-    label_to_cat_id = IdentityLabelMap()
-
-    def __init__(self, delay_s: float = 0.02):
-        self.delay_s = delay_s
-
-    def batch_sizes(self, hw):
-        return [4]
-
-    def max_batch(self, hw):
-        return 4
-
-    def batch_size_for(self, hw, n):
-        return 4
-
-    def warmup(self):
-        pass
-
-    def dispatch(self, hw, images):
-        time.sleep(self.delay_s)
-        b = images.shape[0]
-        boxes = np.tile(
-            np.array([[[1.0, 2.0, 10.0, 20.0]]], np.float32), (b, 1, 1)
-        )
-        return _Det(
-            boxes,
-            np.full((b, 1), 0.5, np.float32),
-            np.zeros((b, 1), np.int32),
-            np.ones((b, 1), bool),
-        )
-
-    def fetch(self, det):
-        return det
 
 
 def _get(url: str) -> tuple[int, bytes]:
@@ -115,7 +71,7 @@ def main() -> int:
 
     img = np.zeros((64, 64, 3), np.uint8)
     server = DetectionServer(
-        StubEngine(),
+        StubDetectEngine(delay_s=0.02),
         ServeConfig(
             max_delay_ms=5.0, admission_queue=2, bucket_queue=2,
             preprocess_workers=1,
@@ -206,6 +162,13 @@ def main() -> int:
             "inflight" in payload.get("load", {})
             and "p99_ms" in payload.get("load", {}),
             "/healthz lacks per-replica load fields",
+        )
+        # Identity (ISSUE 12): the fleet router attributes health by
+        # these — an anonymous payload is a regression.
+        check(
+            bool(payload.get("load", {}).get("replica_id"))
+            and bool(payload.get("load", {}).get("version")),
+            "/healthz load fields lack replica_id/version identity",
         )
         wedge = watchdog.register("smoke-wedged", stall_after=0.01)
         time.sleep(0.05)

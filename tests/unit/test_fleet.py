@@ -1,0 +1,707 @@
+"""serve/fleet.py — fleet router state machine (ISSUE 12).
+
+Everything here runs on the injectable clock: ``poll_once(now=...)``
+drives the health/breaker transitions and ``canary_check_once(now=...)``
+drives the canary gate (the SLO monitor's anti-flap machinery
+underneath), so no test sleeps to make time pass.
+
+Families:
+
+- weight computation from advertised load fields (exact math);
+- circuit open → half-open → close transitions, with the breaker's
+  deterministic backoff schedule between probes;
+- deadline-aware re-dispatch: at most once, never past the deadline;
+- fleet admission control + drain (``shutting_down``);
+- canary gate: fires exactly once per sustained breach, rolls back with
+  one ``canary_rollback``, restores baseline weights.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from batchai_retinanet_horovod_coco_tpu.serve import (
+    DetectionServer,
+    FleetConfig,
+    FleetRouter,
+    LocalReplica,
+    ReplicaUnavailable,
+    RequestRejected,
+    RequestTimeout,
+    ServeConfig,
+    ServerClosed,
+    ServerError,
+)
+from batchai_retinanet_horovod_coco_tpu.serve.fleet import (
+    CLOSED,
+    DRAINED,
+    HALF_OPEN,
+    OPEN,
+    replica_weight,
+)
+from batchai_retinanet_horovod_coco_tpu.serve.stub import (
+    EXPECTED_DETECTIONS,
+    StubDetectEngine,
+)
+from batchai_retinanet_horovod_coco_tpu.utils.backoff import BackoffPolicy
+
+DETS = [{"category_id": 0, "bbox": [1.0, 2.0, 9.0, 18.0], "score": 0.5}]
+
+
+class FakeReplica:
+    """A replica handle with scriptable health and detect behavior."""
+
+    def __init__(
+        self,
+        replica_id: str,
+        version: str = "v1",
+        p99_ms: float | None = 100.0,
+        capacity: int = 8,
+        inflight: int = 0,
+        qsize: int = 0,
+        accepting: bool = True,
+        shed_total: int = 0,
+    ):
+        self.replica_id = replica_id
+        self.version = version
+        self.p99_ms = p99_ms
+        self.capacity = capacity
+        self.inflight = inflight
+        self.qsize = qsize
+        self.accepting = accepting
+        self.shed_total = shed_total
+        self.healthy = True
+        self.healthz_calls = 0
+        self.detect_error: BaseException | None = None
+        self.detect_delay_s = 0.0
+        self.detect_calls = 0
+        self.drained = False
+
+    def load(self) -> dict:
+        return {
+            "replica_id": self.replica_id,
+            "version": self.version,
+            "inflight": self.inflight,
+            "admission_qsize": self.qsize,
+            "admission_capacity": self.capacity,
+            "p99_ms": self.p99_ms,
+            "shed_total": self.shed_total,
+            "accepting": self.accepting,
+        }
+
+    def healthz(self):
+        self.healthz_calls += 1
+        if not self.healthy:
+            return 0, {"status": "unreachable"}
+        return 200, {"status": "ok", "load": self.load()}
+
+    def detect(self, payload, timeout_s=None):
+        self.detect_calls += 1
+        if self.detect_delay_s:
+            time.sleep(self.detect_delay_s)
+        if self.detect_error is not None:
+            raise self.detect_error
+        return DETS
+
+    def drain(self, timeout_s=5.0):
+        self.drained = True
+        self.accepting = False
+
+    def close(self):
+        self.accepting = False
+
+
+#: No-jitter breaker backoff — probe times are exact in these tests.
+EXACT_BACKOFF = BackoffPolicy(
+    max_tries=1_000_000, base_s=1.0, multiplier=2.0, ceiling_s=8.0,
+    jitter=0.0,
+)
+
+
+def make_router(replicas, **cfg) -> FleetRouter:
+    cfg.setdefault("probe_backoff", EXACT_BACKOFF)
+    cfg.setdefault("poll_interval_s", 0.05)
+    return FleetRouter(
+        replicas, FleetConfig(**cfg), auto_poll=False
+    )
+
+
+# ---- weight computation --------------------------------------------------
+
+
+class TestWeights:
+    def test_replica_weight_exact_math(self):
+        load = {
+            "accepting": True, "admission_capacity": 8,
+            "admission_qsize": 2, "inflight": 4, "p99_ms": None,
+        }
+        # headroom 0.75, inflight damping 1/(1 + 4/8) → 0.75 / 1.5 = 0.5
+        assert replica_weight(load) == 0.5
+        # p99 twice the fleet best halves the weight again.
+        load["p99_ms"] = 200.0
+        assert replica_weight(load, p99_ref=100.0) == 0.25
+        # A p99 at (or better than) the reference never boosts above 1x.
+        load["p99_ms"] = 50.0
+        assert replica_weight(load, p99_ref=100.0) == 0.5
+
+    def test_not_accepting_or_empty_is_unroutable(self):
+        assert replica_weight(None) == 0.0
+        assert replica_weight({}) == 0.0
+        assert replica_weight({"accepting": False}) == 0.0
+
+    def test_full_admission_queue_is_unroutable(self):
+        load = {
+            "accepting": True, "admission_capacity": 4,
+            "admission_qsize": 4, "inflight": 0,
+        }
+        assert replica_weight(load) == 0.0
+
+    def test_router_weights_follow_load_fields(self):
+        idle = FakeReplica("idle", inflight=0, qsize=0)
+        busy = FakeReplica("busy", inflight=8, qsize=4)
+        router = make_router([idle, busy])
+        try:
+            status = {
+                r["replica_id"]: r for r in router.status()["replicas"]
+            }
+            assert status["idle"]["weight"] == replica_weight(idle.load())
+            assert status["busy"]["weight"] == replica_weight(busy.load())
+            assert status["idle"]["weight"] > status["busy"]["weight"] > 0
+        finally:
+            router.close()
+
+
+# ---- circuit breaker -----------------------------------------------------
+
+
+class TestBreaker:
+    def test_open_half_open_close_transitions(self):
+        a = FakeReplica("a")
+        b = FakeReplica("b")
+        router = make_router([a, b])
+        try:
+            t = 100.0
+            states = lambda: {  # noqa: E731 — tiny local reader
+                r["replica_id"]: r["state"]
+                for r in router.status()["replicas"]
+            }
+            assert states() == {"a": CLOSED, "b": CLOSED}
+
+            # Health-poll failure opens the breaker on the first miss.
+            a.healthy = False
+            router.poll_once(now=t)
+            assert states()["a"] == OPEN
+            assert states()["b"] == CLOSED
+
+            # Still backing off: polls before the probe time don't touch it.
+            router.poll_once(now=t + 0.5)
+            assert states()["a"] == OPEN
+
+            # First probe at base_s=1.0: replica still down → re-opens
+            # with the NEXT backoff step (2.0 s).
+            router.poll_once(now=t + 1.0)
+            assert states()["a"] == OPEN
+            # The second probe is not due before +1.0+2.0.
+            router.poll_once(now=t + 2.5)
+            assert states()["a"] == OPEN
+
+            # Replica restarts; the due probe (half-open) readmits it.
+            a.healthy = True
+            router.poll_once(now=t + 3.1)
+            assert states()["a"] == CLOSED
+            # ... with routing weight restored.
+            st = {
+                r["replica_id"]: r for r in router.status()["replicas"]
+            }["a"]
+            assert st["weight"] > 0
+        finally:
+            router.close()
+
+    def test_open_replica_takes_no_traffic(self):
+        a = FakeReplica("a")
+        b = FakeReplica("b")
+        router = make_router([a, b], seed=3)
+        try:
+            a.healthy = False
+            router.poll_once(now=10.0)
+            for _ in range(8):
+                assert router.detect(b"payload") == DETS
+            assert a.detect_calls == 0
+            assert b.detect_calls == 8
+        finally:
+            router.close()
+
+    def test_all_breakers_open_sheds_with_reason(self):
+        a = FakeReplica("a")
+        router = make_router([a])
+        try:
+            a.healthy = False
+            router.poll_once(now=10.0)
+            with pytest.raises(RequestRejected) as ei:
+                router.detect(b"payload")
+            assert ei.value.reason == "no_replica_available"
+            code, payload = router.healthz()
+            assert code == 503 and payload["replicas_closed"] == 0
+        finally:
+            router.close()
+
+    def test_dead_replica_on_request_opens_breaker_immediately(self):
+        a = FakeReplica("a")
+        b = FakeReplica("b")
+        router = make_router([a, b])
+        try:
+            a.detect_error = ReplicaUnavailable("a died")
+            b.detect_error = None
+            assert router.detect(b"payload") == DETS
+            # Whichever path the pick took, a dead replica must end OPEN
+            # the moment a request finds it dead (not at the next poll).
+            if a.detect_calls:
+                states = {
+                    r["replica_id"]: r["state"]
+                    for r in router.status()["replicas"]
+                }
+                assert states["a"] == OPEN
+        finally:
+            router.close()
+
+    def test_consecutive_sheds_trip_the_breaker(self):
+        a = FakeReplica("a")
+        router = make_router([a], shed_trip=3, redispatch_limit=0)
+        try:
+            a.detect_error = RequestRejected("admission_queue_full")
+            for _ in range(3):
+                with pytest.raises(RequestRejected):
+                    router.detect(b"payload")
+            states = {
+                r["replica_id"]: r["state"]
+                for r in router.status()["replicas"]
+            }
+            assert states["a"] == OPEN
+        finally:
+            router.close()
+
+
+# ---- re-dispatch ---------------------------------------------------------
+
+
+class TestRedispatch:
+    def test_redispatch_lands_on_another_replica(self):
+        a = FakeReplica("a")
+        b = FakeReplica("b")
+        a.detect_error = ReplicaUnavailable("a died mid-request")
+        router = make_router([a, b])
+        try:
+            assert router.detect(b"payload") == DETS
+            assert b.detect_calls >= 1
+            assert a.detect_calls + b.detect_calls <= 2
+            assert router.status()["redispatches"] <= 1
+        finally:
+            router.close()
+
+    def test_redispatch_happens_at_most_once(self):
+        reps = [FakeReplica(f"r{i}") for i in range(4)]
+        for r in reps:
+            r.detect_error = ReplicaUnavailable("down")
+        router = make_router(reps, redispatch_limit=1)
+        try:
+            with pytest.raises(ServerError):
+                router.detect(b"payload")
+            # redispatch_limit=1 → at most TWO dispatch attempts total,
+            # however many replicas remain untried.
+            assert sum(r.detect_calls for r in reps) == 2
+            assert router.stats.snapshot()["failed"] == 1
+        finally:
+            router.close()
+
+    def test_redispatch_respects_the_deadline(self):
+        a = FakeReplica("a")
+        b = FakeReplica("b")
+        for r in (a, b):
+            r.detect_delay_s = 0.15
+            r.detect_error = ReplicaUnavailable("slow death")
+        router = make_router([a, b])
+        try:
+            with pytest.raises(RequestTimeout):
+                router.detect(b"payload", timeout_s=0.1)
+            # The first dispatch consumed the deadline: no second try.
+            assert a.detect_calls + b.detect_calls == 1
+        finally:
+            router.close()
+
+    def test_decode_error_is_never_redispatched_or_a_breaker_hit(self):
+        """decode_error is the client's fault: no retry, no breaker hit."""
+        a = FakeReplica("a")
+        a.detect_error = RequestRejected("decode_error")
+        router = make_router([a], redispatch_limit=3)
+        try:
+            with pytest.raises(RequestRejected) as ei:
+                router.detect(b"payload")
+            assert ei.value.reason == "decode_error"
+            assert a.detect_calls == 1  # no blind retry of a bad input
+            states = {
+                r["replica_id"]: r["state"]
+                for r in router.status()["replicas"]
+            }
+            assert states["a"] == CLOSED
+        finally:
+            router.close()
+
+
+# ---- admission control + drain -------------------------------------------
+
+
+class TestAdmission:
+    def test_fleet_overloaded_sheds_at_the_edge(self):
+        a = FakeReplica("a")
+        router = make_router([a], max_inflight=1)
+        try:
+            release = threading.Event()
+            started = threading.Event()
+
+            real_detect = a.detect
+
+            def blocking_detect(payload, timeout_s=None):
+                started.set()
+                release.wait(5)
+                return real_detect(payload, timeout_s)
+
+            a.detect = blocking_detect
+            results: list = []
+            t = threading.Thread(  # watchdog: test-local client thread
+                target=lambda: results.append(router.detect(b"p")),
+                daemon=True,
+            )
+            t.start()
+            assert started.wait(5)
+            with pytest.raises(RequestRejected) as ei:
+                router.detect(b"payload")
+            assert ei.value.reason == "fleet_overloaded"
+            release.set()
+            t.join(timeout=5)
+            assert results == [DETS]
+        finally:
+            release.set()
+            router.close()
+
+    def test_closed_router_rejects_with_shutting_down(self):
+        router = make_router([FakeReplica("a")])
+        router.close()
+        with pytest.raises(RequestRejected) as ei:
+            router.detect(b"payload")
+        assert ei.value.reason == "shutting_down"
+        assert router.stats.snapshot()["shed"]["shutting_down"] == 1
+
+
+# ---- canary gate ---------------------------------------------------------
+
+
+def canary_fleet(**cfg):
+    base = [
+        FakeReplica("base-0", p99_ms=100.0),
+        FakeReplica("base-1", p99_ms=100.0),
+    ]
+    cfg.setdefault("canary_for_s", 2.0)
+    cfg.setdefault("canary_p99_factor", 1.5)
+    cfg.setdefault("canary_weight", 0.25)
+    router = make_router(base, **cfg)
+    canary = FakeReplica("canary", version="v2", p99_ms=100.0)
+    router.add_canary(canary)
+    return router, base, canary
+
+
+class TestCanary:
+    def test_canary_takes_fractional_weight_while_green(self):
+        router, base, canary = canary_fleet()
+        try:
+            status = {
+                r["replica_id"]: r for r in router.status()["replicas"]
+            }
+            full = replica_weight(canary.load(), p99_ref=100.0)
+            assert status["canary"]["weight"] == pytest.approx(
+                0.25 * full, abs=1e-6
+            )
+            assert status["canary"]["is_canary"]
+            assert status["base-0"]["weight"] == pytest.approx(
+                replica_weight(base[0].load(), p99_ref=100.0), abs=1e-6
+            )
+        finally:
+            router.close()
+
+    def test_sustained_p99_breach_fires_exactly_one_rollback(self):
+        router, base, canary = canary_fleet()
+        try:
+            canary.p99_ms = 300.0  # 3x the fleet baseline
+            router.poll_once(now=0.0)
+            assert router.canary_check_once(now=0.0) == []  # not sustained
+            assert router.canary_check_once(now=1.0) == []
+            fired = router.canary_check_once(now=2.5)  # for_s=2.0 elapsed
+            assert [v["rule"] for v in fired] == ["canary-p99-regression"]
+            status = router.status()
+            assert status["canary_rollbacks"] == 1
+            assert status["canary_outcome"] == "rolled_back"
+            by_id = {r["replica_id"]: r for r in status["replicas"]}
+            # Drained: zero weight, terminal state, replica drained, and
+            # the fleet back to baseline weights.
+            assert by_id["canary"]["state"] == DRAINED
+            assert by_id["canary"]["weight"] == 0.0
+            assert canary.drained
+            assert by_id["base-0"]["weight"] > 0
+            assert by_id["base-1"]["weight"] > 0
+
+            # Still breaching: the gate never fires again (anti-flap +
+            # the terminal outcome latch).
+            for t in (3.0, 10.0, 100.0):
+                router.poll_once(now=t)
+                assert router.canary_check_once(now=t) == []
+            assert router.status()["canary_rollbacks"] == 1
+        finally:
+            router.close()
+
+    def test_transient_blip_never_fires(self):
+        router, base, canary = canary_fleet()
+        try:
+            canary.p99_ms = 300.0
+            router.poll_once(now=0.0)
+            assert router.canary_check_once(now=0.0) == []
+            canary.p99_ms = 100.0  # heals before for_s elapses
+            router.poll_once(now=1.0)
+            assert router.canary_check_once(now=1.0) == []
+            assert router.canary_check_once(now=10.0) == []
+            assert router.status()["canary_rollbacks"] == 0
+            assert router.status()["canary_outcome"] is None
+        finally:
+            router.close()
+
+    def test_canary_shed_rate_rule_also_gates(self):
+        router, base, canary = canary_fleet(canary_for_s=0.0)
+        try:
+            router.poll_once(now=0.0)
+            assert router.canary_check_once(now=0.0) == []  # delta baseline
+            canary.shed_total = 7  # canary started shedding
+            router.poll_once(now=1.0)
+            fired = router.canary_check_once(now=1.0)
+            assert [v["rule"] for v in fired] == ["canary-shed-rate"]
+            assert router.status()["canary_rollbacks"] == 1
+        finally:
+            router.close()
+
+    def test_rolled_back_local_canary_rejects_shutting_down(self):
+        """The drain half of rollback, on a REAL in-process server: new
+        submits shed with ``shutting_down`` (never queue into a corpse)."""
+        # Fleet baseline p99 far below the slow canary's real latency
+        # (stub dispatch 50 ms), so the ratio rule visibly breaches.
+        base = [FakeReplica("base-0", p99_ms=1.0),
+                FakeReplica("base-1", p99_ms=1.0)]
+        server = DetectionServer(
+            StubDetectEngine(delay_s=0.05),
+            ServeConfig(max_delay_ms=1, preprocess_workers=1),
+            replica_id="canary-local",
+        )
+        router = make_router(base, canary_for_s=0.0, canary_weight=0.5)
+        try:
+            canary = LocalReplica(server)
+            router.add_canary(canary)
+            # Give the canary a visibly-regressed p99 via real traffic
+            # (the stub device is slow); then let the gate see it.
+            import numpy as np
+
+            img = np.zeros((64, 64, 3), np.uint8)
+            canary.detect(img, timeout_s=10)
+            router.poll_once(now=0.0)
+            fired = router.canary_check_once(now=0.0)
+            assert [v["rule"] for v in fired] == ["canary-p99-regression"]
+            with pytest.raises(ServerClosed):
+                server.submit(img)
+            assert server.snapshot()["shed"].get("shutting_down") == 1
+            assert router.status()["canary_outcome"] == "rolled_back"
+        finally:
+            router.close()
+            server.close(drain=False)
+
+    def test_canary_slot_is_reusable_after_rollback(self):
+        """A rolled-back canary frees the slot: a fixed next version can
+        be admitted without restarting the router, and ITS sustained
+        breach fires its own (single) rollback."""
+        router, base, canary = canary_fleet()
+        try:
+            canary.p99_ms = 300.0
+            router.poll_once(now=0.0)
+            router.canary_check_once(now=0.0)
+            assert router.canary_check_once(now=2.5)  # rollback #1
+            assert router.status()["canary_rollbacks"] == 1
+
+            v3 = FakeReplica("canary-v3", version="v3", p99_ms=100.0)
+            router.add_canary(v3)  # must not raise "already under evaluation"
+            assert router.status()["canary_outcome"] is None
+            by_id = {
+                r["replica_id"]: r for r in router.status()["replicas"]
+            }
+            assert by_id["canary-v3"]["is_canary"]
+            assert by_id["canary"]["state"] == DRAINED  # v2 stays visible
+
+            v3.p99_ms = 400.0
+            router.poll_once(now=10.0)
+            router.canary_check_once(now=10.0)
+            assert router.canary_check_once(now=12.5)  # rollback #2
+            assert router.status()["canary_rollbacks"] == 2
+            assert v3.drained
+        finally:
+            router.close()
+
+    def test_promotion_graduates_to_full_weight(self):
+        router, base, canary = canary_fleet()
+        try:
+            router.promote_canary()
+            router.poll_once(now=5.0)
+            by_id = {
+                r["replica_id"]: r for r in router.status()["replicas"]
+            }
+            assert not by_id["canary"]["is_canary"]
+            assert by_id["canary"]["weight"] == pytest.approx(
+                replica_weight(canary.load(), p99_ref=100.0), abs=1e-6
+            )
+            assert router.status()["canary_outcome"] == "promoted"
+            assert router.status()["canary_rollbacks"] == 0
+        finally:
+            router.close()
+
+
+# ---- telemetry surface ---------------------------------------------------
+
+
+class TestTelemetry:
+    def test_fleet_metrics_families_present(self):
+        a = FakeReplica("a")
+        b = FakeReplica("b")
+        router = make_router([a, b])
+        try:
+            router.detect(b"payload")
+            a.healthy = False
+            router.poll_once(now=50.0)
+            snap = router.telemetry.snapshot()
+            assert snap["fleet_requests_completed_total"] == 1
+            assert snap['fleet_breaker_state{replica="a"}'] == 2.0  # OPEN
+            assert snap['fleet_breaker_state{replica="b"}'] == 0.0
+            assert snap['fleet_replica_weight{replica="b"}'] > 0
+            assert snap["fleet_breaker_open_total"] == 1
+            text = router.telemetry.prometheus_text()
+            assert "fleet_request_latency_ms" in text
+            assert "fleet_replica_weight" in text
+        finally:
+            router.close()
+
+    def test_healthz_degrades_but_stays_up_with_one_replica(self):
+        a = FakeReplica("a")
+        b = FakeReplica("b")
+        router = make_router([a, b])
+        try:
+            a.healthy = False
+            router.poll_once(now=5.0)
+            code, payload = router.healthz()
+            assert code == 200
+            assert payload["replicas_closed"] == 1
+            assert router.detect(b"payload") == DETS  # degraded, serving
+        finally:
+            router.close()
+
+
+# ---- HTTP replica error taxonomy -----------------------------------------
+
+
+class TestHttpReplicaTaxonomy:
+    def test_socket_timeout_is_request_timeout_not_replica_death(self):
+        """A slow-but-alive replica (socket accepts, never answers) is a
+        RequestTimeout — a request outcome, never a breaker hit or a
+        re-dispatch while the original may still be executing."""
+        import socket
+
+        from batchai_retinanet_horovod_coco_tpu.serve.replica import (
+            HttpReplica,
+        )
+
+        listener = socket.socket()
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(1)
+        try:
+            host, port = listener.getsockname()
+            rep = HttpReplica(f"http://{host}:{port}", timeout_s=0.3)
+            with pytest.raises(RequestTimeout):
+                rep.detect(b"payload", timeout_s=0.3)
+        finally:
+            listener.close()
+
+    def test_refused_connection_is_replica_unavailable(self):
+        import socket
+
+        from batchai_retinanet_horovod_coco_tpu.serve.replica import (
+            HttpReplica,
+        )
+
+        with socket.socket() as s:  # grab a port, then free it
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        rep = HttpReplica(f"http://127.0.0.1:{port}", timeout_s=0.5)
+        with pytest.raises(ReplicaUnavailable):
+            rep.detect(b"payload", timeout_s=0.5)
+        code, payload = rep.healthz()
+        assert code == 0 and payload["status"] == "unreachable"
+
+
+# ---- routing is transport, not math (PARITY §5.16) -----------------------
+
+
+class TestRoutingParity:
+    def test_routed_detections_bit_identical_to_direct(self):
+        """The router never touches detection payloads: a request through
+        the fleet returns byte-for-byte what the replica's own submit()
+        returns for the same image."""
+        import numpy as np
+
+        server = DetectionServer(
+            StubDetectEngine(),
+            ServeConfig(max_delay_ms=5, preprocess_workers=1),
+            replica_id="parity-r0",
+        )
+        router = make_router([LocalReplica(server)])
+        try:
+            img = np.zeros((64, 64, 3), np.uint8)
+            direct = server.submit(img).result(timeout=30)
+            routed = router.detect(img)
+            assert routed == direct == EXPECTED_DETECTIONS
+        finally:
+            router.close()
+            server.close(drain=False)
+
+
+# ---- half-open probe schedule is the backoff policy's, exactly -----------
+
+
+class TestProbeSchedule:
+    def test_probe_times_follow_policy_delays(self):
+        a = FakeReplica("a")
+        policy = BackoffPolicy(
+            max_tries=1_000_000, base_s=1.0, multiplier=2.0,
+            ceiling_s=4.0, jitter=0.0,
+        )
+        router = make_router([a, FakeReplica("b")], probe_backoff=policy)
+        try:
+            a.healthy = False
+            router.poll_once(now=0.0)  # fails → OPEN, probe due at +1.0
+            # Each re-open schedules the NEXT policy delay from the probe
+            # time: delays 1, 2, 4, 4 (ceiling) → dues 1, 3, 7, 11.
+            for due in (1.0, 3.0, 7.0, 11.0):
+                before = a.healthz_calls
+                router.poll_once(now=due - 0.01)  # backing off: no probe
+                assert a.healthz_calls == before
+                router.poll_once(now=due)  # due: exactly one probe
+                assert a.healthz_calls == before + 1
+            states = {
+                r["replica_id"]: r["state"]
+                for r in router.status()["replicas"]
+            }
+            assert states["a"] == OPEN  # stayed dead the whole time
+        finally:
+            router.close()

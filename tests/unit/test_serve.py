@@ -38,7 +38,12 @@ from batchai_retinanet_horovod_coco_tpu.serve import (
     ServerError,
     serve_http,
 )
-from batchai_retinanet_horovod_coco_tpu.serve.engine import IdentityLabelMap
+# The canonical stub engine (serve/stub.py — ISSUE 12 unified the
+# private copies this file and telemetry_smoke.py used to carry).
+from batchai_retinanet_horovod_coco_tpu.serve.stub import (
+    EXPECTED_DETECTIONS,
+    StubDetectEngine as StubEngine,
+)
 
 # repo root (for scripts/), derived from this file's own path
 REPO_ROOT = os.path.dirname(
@@ -46,64 +51,8 @@ REPO_ROOT = os.path.dirname(
 )
 
 
-# ---- stub engine ---------------------------------------------------------
-
-
-class _Det:
-    def __init__(self, boxes, scores, labels, valid):
-        self.boxes, self.scores, self.labels = boxes, scores, labels
-        self.valid = valid
-
-
-class StubEngine:
-    """One fixed detection per batch row; records dispatched batch sizes."""
-
-    min_side = 64
-    max_side = 64
-    buckets = ((64, 64),)
-    label_to_cat_id = IdentityLabelMap()
-
-    def __init__(self, batch_sizes=(4,), delay_s: float = 0.0):
-        self._sizes = sorted(batch_sizes)
-        self.delay_s = delay_s
-        self.dispatched: list[int] = []
-
-    def batch_sizes(self, hw):
-        return list(self._sizes)
-
-    def max_batch(self, hw):
-        return self._sizes[-1]
-
-    def batch_size_for(self, hw, n):
-        for b in self._sizes:
-            if b >= n:
-                return b
-        return self._sizes[-1]
-
-    def warmup(self):
-        pass
-
-    def dispatch(self, hw, images):
-        if self.delay_s:
-            time.sleep(self.delay_s)
-        b = images.shape[0]
-        self.dispatched.append(b)
-        boxes = np.tile(
-            np.array([[[1.0, 2.0, 10.0, 20.0]]], np.float32), (b, 1, 1)
-        )
-        return _Det(
-            boxes,
-            np.full((b, 1), 0.5, np.float32),
-            np.zeros((b, 1), np.int32),
-            np.ones((b, 1), bool),
-        )
-
-    def fetch(self, det):
-        return det
-
-
 IMG = np.zeros((64, 64, 3), np.uint8)
-EXPECTED = [{"category_id": 0, "bbox": [1.0, 2.0, 9.0, 18.0], "score": 0.5}]
+EXPECTED = EXPECTED_DETECTIONS
 
 
 def make_server(engine=None, **cfg) -> DetectionServer:
@@ -341,6 +290,36 @@ class TestHttp:
                 httpd.server_close()
 
 
+# ---- replica identity (ISSUE 12 satellite) -------------------------------
+
+
+class TestIdentity:
+    def test_load_fields_carry_replica_id_and_version(self):
+        """The fleet router cannot attribute health/weight without
+        identity: every load_fields() payload names its replica and its
+        engine's export version (stub engines say 'stub')."""
+        with make_server() as srv:
+            load = srv.load_fields()
+        assert load["replica_id"]  # host-pid default, non-empty
+        assert load["version"] == "stub"
+
+    def test_explicit_replica_id_is_stable(self):
+        srv = make_server()
+        try:
+            default_id = srv.replica_id
+        finally:
+            srv.close()
+        srv = DetectionServer(
+            StubEngine(), ServeConfig(preprocess_workers=1),
+            replica_id="replica-7",
+        )
+        try:
+            assert srv.load_fields()["replica_id"] == "replica-7"
+            assert srv.load_fields()["replica_id"] != default_id
+        finally:
+            srv.close()
+
+
 # ---- real model: THE parity pin + export engine --------------------------
 
 
@@ -499,6 +478,8 @@ def test_engine_from_export_bit_identical_to_eval_on_same_artifacts(
     assert engine.buckets == ((64, 64),)
     assert engine.batch_sizes((64, 64)) == [2]
     assert engine.min_side == 64 and engine.max_side == 64
+    # Rollout identity: no manifest version → the export dir's basename.
+    assert engine.version == "exp"
     with DetectionServer(
         engine, ServeConfig(max_delay_ms=100, preprocess_workers=1)
     ) as srv:

@@ -23,8 +23,8 @@ CLUSTER = python -m batchai_retinanet_horovod_coco_tpu.launch.cluster
 	evalbench-check servebench servebench-check canaries \
 	convergence-full lint lint-obs check-static tune-smoke tunebench \
 	tunebench-check perf-report perf-report-check telemetry-smoke \
-	numerics-smoke chaos chaos-smoke ckptbench ckptbench-check \
-	fleet-smoke
+	numerics-smoke chaos chaos-smoke chaos-comm ckptbench \
+	ckptbench-check fleet-smoke commbench commbench-check
 
 create:
 	$(CLUSTER) create --name $(NAME) --zone $(ZONE) --accelerator $(ACCEL) $(DRYFLAG)
@@ -70,6 +70,7 @@ bench-check:
 	BENCH_SWEEP=0 BENCH_NUMERICS=0 BENCH_CHECK=1 python bench.py
 	BENCH_SWEEP=0 EVALBENCH_E2E=0 BENCH_CHECK=1 python bench.py --mode eval
 	BENCH_SWEEP=0 SERVEBENCH_OVERLOAD=0 BENCH_CHECK=1 python bench.py --mode serve
+	$(MAKE) commbench-check
 	$(MAKE) perf-report-check
 	$(MAKE) telemetry-smoke
 
@@ -153,6 +154,29 @@ chaos:
 
 chaos-smoke:
 	JAX_PLATFORMS=cpu python scripts/chaos.py --smoke
+
+# COMMBENCH (ISSUE 13, bench.py --mode comm + scripts/commbench_sweep.py):
+# the gradient-compression subsystem's committed evidence — bytes-on-wire
+# vs exact (the <= 0.65x claim), step-time delta, and parity drift after
+# N identical steps, per variant (int8 / int8+overlap / bf16 / 1MB
+# buckets), on a forced 8-device virtual CPU mesh (bytes + parity are
+# device-independent; timing is indicative).  commbench-check is the
+# tripwire: int8-only re-measure vs the committed COMMBENCH.json (bytes
+# ratio hard <= 0.65 AND <= committed + 0.02, drift band, device-class
+# guard) with the exit-75 outage contract from bench.py's shared probe.
+commbench:
+	JAX_PLATFORMS=cpu python scripts/commbench_sweep.py
+
+commbench-check:
+	JAX_PLATFORMS=cpu BENCH_SWEEP=0 BENCH_CHECK=1 python bench.py --mode comm
+
+# Comm chaos leg alone (ISSUE 13, scripts/chaos.py --comm): SIGKILL a
+# compressed+EF training run mid-save, assert the resume restores the EF
+# residual state from the checkpoint (or cleanly zeros it with ONE
+# structured ef_reset event) and the losses rejoin the uninterrupted
+# baseline envelope.  Also part of the full `make chaos` schedule.
+chaos-comm:
+	JAX_PLATFORMS=cpu python scripts/chaos.py --comm
 
 # Serve-fleet chaos (ISSUE 12, scripts/chaos.py --serve): the REAL fleet
 # CLI over 2 stub-engine replica subprocesses — SIGKILL one mid-load and

@@ -37,6 +37,12 @@ NAME = "collective-safety"
 COLLECTIVES = frozenset({
     "psum", "pmean", "pmax", "pmin", "psum_scatter", "all_gather",
     "all_to_all", "ppermute", "pshuffle", "axis_index_groups",
+    # comm/ subsystem wrappers (ISSUE 13): each of these performs
+    # psum_scatter/all_gather internally, so a rank-guarded CALL to the
+    # wrapper is the same deadlock as a rank-guarded raw collective —
+    # the rule must see through the abstraction.
+    "reduce_tree", "zero_gather_updates", "bucketed_pmean",
+    "reduce_leaves", "quantized_pmean", "comm_metrics",
 })
 _RANKY = frozenset({
     "process_index", "process_count", "rank", "local_rank", "host_id",

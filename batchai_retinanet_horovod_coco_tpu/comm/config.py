@@ -1,0 +1,111 @@
+"""CommConfig: the policy layer for how gradients cross the interconnect.
+
+ISSUE 13 replaces the bare ``quantized_allreduce`` bool (one ``if`` in
+train/step.py, per-leaf, no error feedback, unmeasured) with a first-class
+policy object the whole stack resolves from:
+
+- ``compress`` selects the wire format of the compressible collective
+  phase: ``"none"`` (exact f32 — the compiled step is byte-identical to
+  pre-ISSUE-13), ``"int8"`` (EQuARX-style symmetric per-block int8,
+  ~5/8 the exact bytes-on-wire), or ``"bf16"`` (round-to-nearest bf16,
+  ~3/4 the exact bytes);
+- ``error_feedback`` carries the residual each step's quantization
+  dropped in opt_state-adjacent comm state (``TrainState.comm_state``)
+  and adds it back before the next quantize — the standard EF trick that
+  turns biased rounding into an unbiased-in-expectation scheme (the
+  telescoping sum: applied_1..T + residual_T == exact_1..T);
+- ``overlap`` issues each schedule stage's compressed collective from
+  INSIDE the backward pass (comm/overlap.py custom-vjp staging) so the
+  interconnect works while later stages' gradients are still being
+  computed; off, the whole tree reduces in one fused pass after the
+  backward (identical math, fewer/larger collectives);
+- ``bucket_mb`` packs many small leaves into one flattened bucket per
+  schedule stage so they share ONE quantized collective (and one scale
+  vector) instead of paying per-leaf collective latency + scale traffic;
+- ``min_bucket_bytes`` subsumes the old ``parallel/quantize.py``
+  ``_MIN_QUANTIZE_SIZE`` per-leaf blind spot: a bucket whose total
+  payload is below this stays exact (the wire saving is noise there),
+  but small leaves themselves are no longer skipped — they ride inside
+  full-size buckets;
+- ``stage_modes`` is the per-role policy override: e.g.
+  ``(("heads", "bf16"),)`` keeps the (small, sensitive) head gradients
+  at bf16 while the backbone runs int8.
+
+The object is a frozen dataclass so step factories can key compile
+caches on it and workers can reconstruct it from CLI flags
+deterministically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+#: Comm schedule stages, in backward-completion order: the heads' grads
+#: exist first, the backbone's last — overlap issues each stage's
+#: collective as soon as its cotangents exist.  Top-level param keys map
+#: onto stages via ``stage_of``; anything that is not backbone/fpn
+#: (cls_head, box_head, test models' ad-hoc keys) is "heads".
+STAGES = ("backbone", "fpn", "heads")
+
+COMPRESS_MODES = ("none", "int8", "bf16")
+
+
+def stage_of(top_key: str) -> str:
+    """Schedule stage of a top-level parameter key."""
+    key = str(top_key)
+    if key in ("backbone", "fpn"):
+        return key
+    return "heads"
+
+
+@dataclasses.dataclass(frozen=True)
+class CommConfig:
+    """Policy for the gradient collectives (see module docstring)."""
+
+    compress: str = "none"  # "none" | "int8" | "bf16"
+    error_feedback: bool = True
+    overlap: bool = False
+    bucket_mb: float = 4.0
+    # Buckets with payload below this stay exact (subsumes the old
+    # per-leaf _MIN_QUANTIZE_SIZE = 8192 elements x 4 bytes).
+    min_bucket_bytes: int = 32768
+    block: int = 512  # elements per int8 scale (EQuARX-style blocks)
+    # Per-role overrides: ((stage, mode), ...) — mode for unlisted
+    # stages is ``compress``.
+    stage_modes: tuple = ()
+
+    def __post_init__(self):
+        if self.compress not in COMPRESS_MODES:
+            raise ValueError(
+                f"CommConfig.compress must be one of {COMPRESS_MODES}, "
+                f"got {self.compress!r}"
+            )
+        for stage, mode in self.stage_modes:
+            if mode not in COMPRESS_MODES:
+                raise ValueError(
+                    f"stage_modes[{stage!r}] must be one of "
+                    f"{COMPRESS_MODES}, got {mode!r}"
+                )
+        if self.bucket_mb <= 0:
+            raise ValueError("bucket_mb must be positive")
+        if self.block <= 0:
+            raise ValueError("block must be positive")
+
+    @property
+    def enabled(self) -> bool:
+        """Any compression at all (overlap without compression still
+        routes through the comm reduce, so it counts)."""
+        return self.compress != "none" or self.overlap
+
+    @property
+    def needs_state(self) -> bool:
+        """Does this policy carry cross-step comm state (EF residuals)?"""
+        return self.error_feedback and self.compress != "none"
+
+    def mode_for_stage(self, stage: str) -> str:
+        return dict(self.stage_modes).get(stage, self.compress)
+
+    @property
+    def bucket_elems(self) -> int:
+        """Bucket capacity in f32 elements."""
+        return max(1, int(self.bucket_mb * (1 << 20) / 4))

@@ -75,6 +75,17 @@ NONFINITE = "nonfinite_grads"
 REPLICA_AGREEMENT = "replica_agreement"
 GROUP_PREFIX = "gnorm/"
 
+#: Comm-subsystem health keys (ISSUE 13, comm/compress.comm_metrics):
+#: the error-feedback residual's global norm, the fraction of quantized
+#: elements at the clip boundary (scale saturation — a spike means the
+#: gradient distribution blew past the per-block scales), and the
+#: plan's static bytes-on-wire.  Same vocabulary discipline as the
+#: numerics keys: the step emits them, the loop's record site feeds the
+#: telemetry gauges, the ef_residual_spike SLO rule watches the gauge.
+EF_RESIDUAL = "ef_residual_norm"
+EF_SATURATION = "ef_saturation"
+COMM_BYTES = "comm_compressed_bytes"
+
 #: Scalars whose non-finiteness the provenance pass attributes first, in
 #: root-cause order (a NaN cls_loss names the classification path even
 #: though the total loss is NaN too).

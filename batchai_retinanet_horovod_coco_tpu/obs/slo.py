@@ -463,6 +463,37 @@ def grad_norm_spike(
     )
 
 
+def ef_residual_spike(
+    factor: float = 10.0,
+    window: int = 32,
+    metric: str = "train_ef_residual",
+    for_s: float = 0.0,
+) -> SloRule:
+    """Gradient-compression health tripwire (ISSUE 13): the error-
+    feedback residual norm vs ``factor ×`` its own rolling-median
+    HEALTHY baseline.  A compressed gradient degrading training shows
+    up here first — a residual spike means the per-block int8 scales
+    stopped fitting the gradient distribution (saturation), i.e. the
+    quantizer is now dropping signal the optimizer needed.  Regression
+    mode, like ``grad_norm_spike``: no absolute ceiling to hand-pick,
+    and the rule stays silent on runs without compression (the
+    ``train_ef_residual`` gauge never exists), so it is ALWAYS armed in
+    train.py's built-in rule set."""
+    return SloRule(
+        name="ef_residual_spike",
+        metric=metric,
+        op=">",
+        baseline_window=window,
+        factor=factor,
+        for_s=for_s,
+        description=(
+            f"gradient-compression EF residual above {factor}x its "
+            "rolling-median baseline (per-block scales saturating; "
+            "compressed gradients dropping signal)"
+        ),
+    )
+
+
 #: ``--slo-rule`` grammar:  METRIC OP THRESHOLD [@FOR_S]
 #: where OP ∈ {>, >=, <, <=} and THRESHOLD is either a number (static
 #: ceiling/floor) or ``xFACTOR`` (regression vs the rolling-median

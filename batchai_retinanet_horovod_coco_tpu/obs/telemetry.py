@@ -727,6 +727,24 @@ def _train_handles() -> dict[str, Any]:
                 "non-finite gradient elements observed + tripped "
                 "finite-checks (any increase is an incident)",
             ),
+            # Comm subsystem / gradient-compression health (ISSUE 13,
+            # comm/compress.py): the always-armed ef_residual_spike SLO
+            # rule evaluates train_ef_residual.
+            "ef_residual": r.gauge(
+                "train_ef_residual",
+                "global L2 norm of the gradient-compression error-"
+                "feedback residual at the last log window",
+            ),
+            "ef_saturation": r.gauge(
+                "train_ef_saturation",
+                "fraction of quantized elements at the int8 clip "
+                "boundary (per-block scale saturation)",
+            ),
+            "comm_bytes": r.counter(
+                "train_comm_compressed_bytes_total",
+                "cumulative compressed gradient bytes-on-wire "
+                "(per-device ring estimate, comm/compress plan)",
+            ),
         }
     return _train_gauges
 
@@ -787,6 +805,28 @@ def record_numerics(
         g["nonfinite"].inc(
             float(nonfinite) if math.isfinite(nonfinite) else 1.0
         )
+
+
+def record_comm(
+    ef_residual: float | None = None,
+    ef_saturation: float | None = None,
+    compressed_bytes: float | None = None,
+    steps: int = 1,
+) -> None:
+    """The train loop's comm/EF record site (ISSUE 13; per log window).
+    One bool check while telemetry is off; absent fields (compression
+    off, EF off) are skipped.  ``compressed_bytes`` is the plan's
+    static per-step figure — the counter accumulates it over the
+    window's ``steps``."""
+    if not _enabled:
+        return
+    g = _train_handles()
+    if ef_residual is not None and math.isfinite(ef_residual):
+        g["ef_residual"].set(float(ef_residual))
+    if ef_saturation is not None and math.isfinite(ef_saturation):
+        g["ef_saturation"].set(float(ef_saturation))
+    if compressed_bytes is not None and math.isfinite(compressed_bytes):
+        g["comm_bytes"].inc(float(compressed_bytes) * max(1, int(steps)))
 
 
 def record_nonfinite_trip(metric: str) -> None:

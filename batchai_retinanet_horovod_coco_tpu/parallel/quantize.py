@@ -1,97 +1,38 @@
-"""Quantized gradient all-reduce: trade gradient precision for ICI bandwidth.
+"""DEPRECATED shim over the comm subsystem (ISSUE 13).
 
-SURVEY.md §5.8 names EQuARX-style quantized all-reduce (PAPERS.md) as the
-optional bandwidth optimization over the plain compiled ``pmean``.  True
-in-ring requantization is not expressible with XLA's collectives, so this is
-the two-phase decomposition with the compression on the phase that can take
-it:
+This module used to hold the per-leaf int8-gather pmean (SURVEY.md §5.8's
+EQuARX-style option).  That implementation had a structural blind spot:
+leaves below ``_MIN_QUANTIZE_SIZE`` were skipped PER LEAF — every bias
+and norm scale paid exact bytes AND its own collective — and there was
+no error feedback, so the rounding bias compounded step over step.
 
-  1. ``psum_scatter`` in f32 — each device ends up owning the fully-reduced
-     1/N shard of every gradient (wire cost (N-1)/N · 4S bytes, same as the
-     first half of a ring all-reduce; summation precision is untouched);
-  2. per-BLOCK int8 quantization (symmetric, max/127 scale per
-     ``_QUANT_BLOCK``-element block, EQuARX-style) and an int8
-     ``all_gather`` of shards + f32 block scales (wire cost (N-1)/N · S
-     bytes + one f32 per block — <1% overhead at block 512 — vs · 4S for
-     the f32 gather half).
+``comm/compress.py`` subsumes it: leaves pack into per-stage buckets
+(small leaves ride inside full buckets; only a bucket whose total
+payload is under ``CommConfig.min_bucket_bytes`` — the successor of the
+old per-leaf constant — stays exact), the reduce keeps the exact-f32
+two-phase decomposition, and error feedback carries the dropped
+rounding in ``TrainState.comm_state``.
 
-Total wire traffic ≈ 5/8 of the plain all-reduce.  Every device dequantizes
-the same gathered bytes, so the replicated update stays bitwise-identical
-across devices; the only error is one symmetric rounding of the ALREADY
-REDUCED gradient, bounded per element by max|block| / 254 — tighter than
-quantize-before-reduce schemes, whose error compounds over N summands.
-Block-local scales matter because gradients are heavy-tailed: with one
-scale per multi-million-element shard, a single outlier zeroes every
-element below max|shard|/254 (100% relative error for small-magnitude
-entries); a 512-element block bounds an outlier's blast radius to its own
-block (ADVICE r2).
-Opt-in via ``--quantized-allreduce`` (train/step.py); gradient clipping and
-the optimizer run on the dequantized values unchanged.
+``quantized_pmean`` remains as a thin stateless alias so old call sites
+(``make_train_step(quantized_allreduce=True)``, the 2-process pod
+worker's "quantized" flavor) keep working; new code should build a
+``comm.CommConfig`` instead.
 """
 
 from __future__ import annotations
 
-import jax
-import jax.numpy as jnp
-from jax import lax
-
-from batchai_retinanet_horovod_coco_tpu.parallel.zero import _pad_flat
-
-_MIN_QUANTIZE_SIZE = 8192  # below this the wire saving is noise; stay exact
-_QUANT_BLOCK = 512  # elements per int8 scale (EQuARX-style block scaling)
-
-
-def _quantized_pmean_flat(flat: jnp.ndarray, axis_name: str, n: int) -> jnp.ndarray:
-    """pmean of a flat f32 vector via reduce-scatter + int8 all-gather."""
-    size = flat.shape[0]
-    flat = _pad_flat(flat, n)  # shared pad-to-shardable rule (zero.py)
-    # Phase 1: exact f32 reduction; each device owns one reduced shard.
-    shard = lax.psum_scatter(flat, axis_name, tiled=True) / n
-    # Phase 2: symmetric int8 with per-block scales (gathered alongside);
-    # block-local scaling keeps an outlier from zeroing the whole shard.
-    m = shard.shape[0]
-    blocks = -(-m // _QUANT_BLOCK)
-    sb = jnp.pad(shard, (0, blocks * _QUANT_BLOCK - m)).reshape(
-        blocks, _QUANT_BLOCK
-    )
-    amax = jnp.max(jnp.abs(sb), axis=1)  # (blocks,)
-    # A non-finite gradient must SURFACE (the loop's non-finite-loss abort,
-    # SURVEY §5.2) — int8 casting would launder Inf/NaN into finite garbage,
-    # so poison that block's gathered scale instead: its dequantized values
-    # go NaN and the divergence aborts exactly like the exact-pmean path.
-    scale = jnp.where(
-        jnp.isfinite(amax), jnp.maximum(amax, 1e-30) / 127.0, jnp.nan
-    )
-    q = jnp.clip(jnp.round(sb / scale[:, None]), -127.0, 127.0).astype(jnp.int8)
-    q_all = lax.all_gather(q, axis_name)  # (n, blocks, _QUANT_BLOCK) int8
-    s_all = lax.all_gather(scale, axis_name)  # (n, blocks) f32
-    out = (
-        (q_all.astype(jnp.float32) * s_all[..., None])
-        .reshape(n, blocks * _QUANT_BLOCK)[:, :m]
-        .reshape(-1)
-    )
-    return out[:size]
+from batchai_retinanet_horovod_coco_tpu.comm.compress import (
+    bucketed_pmean,
+)
 
 
 def quantized_pmean(grads, axis_name: str, n: int):
-    """``lax.pmean`` over ``axis_name`` with int8-compressed gather phase.
+    """DEPRECATED: stateless bucketed int8 pmean (no error feedback).
 
-    Leaves smaller than ``_MIN_QUANTIZE_SIZE`` elements (biases, norm
-    scales — a rounding there is all pain, no bandwidth) and non-float
-    leaves take the exact ``pmean``.
+    Alias for ``comm.compress.bucketed_pmean`` with the default int8
+    policy — same exact-reduce-then-quantize error bound as the old
+    per-leaf path (one symmetric rounding of the ALREADY REDUCED
+    gradient, ≤ max|block| / 254 per element), minus the per-leaf
+    small-tensor blind spot.
     """
-
-    def one(g):
-        if g.size < _MIN_QUANTIZE_SIZE or not jnp.issubdtype(
-            g.dtype, jnp.floating
-        ):
-            return lax.pmean(g, axis_name)
-        return (
-            _quantized_pmean_flat(
-                g.astype(jnp.float32).reshape(-1), axis_name, n
-            )
-            .reshape(g.shape)
-            .astype(g.dtype)
-        )
-
-    return jax.tree.map(one, grads)
+    return bucketed_pmean(grads, axis_name, n)

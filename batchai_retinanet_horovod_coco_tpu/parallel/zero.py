@@ -237,6 +237,7 @@ def sharded_update(
     *,
     n: int,
     loss_value: jnp.ndarray | None = None,
+    gather_updates=None,
 ) -> tuple[Any, Any, dict[str, jnp.ndarray]]:
     """One weight update on this device's shard; call INSIDE shard_map.
 
@@ -246,6 +247,15 @@ def sharded_update(
     ``shard_clip_axis``) so the norm is global across shards.  Returns
     (new_params FULL via all_gather, new_opt_state local shards,
     info dict with the pre-clip ``grad_norm`` — SURVEY.md §5.5 metric).
+
+    ``gather_updates(updates, params) -> new_params`` (optional, ISSUE
+    13): replaces the f32 param all-gather with a caller-owned
+    collective over the optax UPDATE shards — the comm subsystem's
+    compressed update gather (``comm/compress.zero_gather_updates``),
+    which is what makes ZeRO + compression composable (gathering the
+    gradient-like update with error feedback instead of quantizing the
+    params themselves).  The gradient reduce-scatter, the sharded
+    optimizer update, and the global clip norm are UNCHANGED either way.
     """
     index = lax.axis_index(DATA_AXIS)
     gshards = jax.tree.map(
@@ -269,6 +279,11 @@ def sharded_update(
         )
     else:
         updates, new_opt_state = tx.update(gshards, opt_state, pshards)
+    if gather_updates is not None:
+        # Compressed path: every device applies the identical
+        # dequantized full update to its replicated params, so the
+        # params stay bitwise replicated without an f32 gather.
+        return gather_updates(updates, params), new_opt_state, info
     new_pshards = optax.apply_updates(pshards, updates)
     new_params = jax.tree.map(_unshard, new_pshards, params)
     return new_params, new_opt_state, info

@@ -317,7 +317,11 @@ class _AsyncEvalRunner:
         import jax.numpy as jnp
 
         self.join()  # one in flight; also surfaces a prior failure
-        snapshot = jax.tree.map(jnp.copy, state.replace(opt_state=()))
+        # Drop opt_state AND comm_state (ISSUE 13 EF residuals): eval
+        # reads neither, and the residuals are data-axis-sharded.
+        snapshot = jax.tree.map(
+            jnp.copy, state.replace(opt_state=(), comm_state=())
+        )
 
         def run() -> None:
             # Registered but immediately idle: a mid-training eval is
@@ -463,6 +467,7 @@ def run_training(
     logger: MetricLogger | None = None,
     shard_weight_update: bool = False,
     quantized_allreduce: bool = False,
+    comm=None,
     allow_data_axis_divergence: bool = False,
 ) -> TrainState:
     """Run ``config.total_steps`` of SPMD training; returns the final state.
@@ -471,15 +476,22 @@ def run_training(
     every ``eval_every`` steps and at the end.  One train step is compiled
     per (H, W) shape bucket seen in the stream.
 
+    ``comm`` (a ``comm.CommConfig``, ISSUE 13) selects the gradient-
+    communication policy — bucketed int8/bf16 compression with error
+    feedback, optional backward overlap; composes with
+    ``shard_weight_update`` (the compression moves to the ZeRO update
+    gather).  ``quantized_allreduce`` is the deprecated bool alias.
+
     A 2-D mesh carrying a ``space`` axis selects the spatially partitioned
     step (image-H sharding; train/step.py::make_train_step_spatial) —
-    exclusive with the ZeRO and quantized-allreduce flavors.
+    exclusive with the ZeRO and comm-compression flavors.
     """
     spatial = mesh is not None and SPACE_AXIS in mesh.axis_names
-    if spatial and (shard_weight_update or quantized_allreduce):
+    comm_on = comm is not None and getattr(comm, "enabled", False)
+    if spatial and (shard_weight_update or quantized_allreduce or comm_on):
         raise ValueError(
             "spatial partitioning is exclusive with --shard-weight-update "
-            "and --quantized-allreduce"
+            "and --comm-compress/--quantized-allreduce"
         )
     logger = logger or MetricLogger(log_dir=None)
     ckpt = None
@@ -541,6 +553,21 @@ def run_training(
         # single device, which conflicts with the shard_map'd step).  In
         # weight-update-sharded mode the opt_state leaves keep their 1/N
         # layout on the data axis instead (parallel/zero.py storage format).
+        def _place_comm_state(comm_state):
+            # Comm EF residuals (ISSUE 13) keep their 1/N data-axis
+            # layout, exactly like ZeRO optimizer state.
+            from jax.sharding import NamedSharding
+
+            from batchai_retinanet_horovod_coco_tpu.comm.compress import (
+                state_partition_specs,
+            )
+
+            return jax.tree.map(
+                lambda x, spec: jax.device_put(x, NamedSharding(mesh, spec)),
+                comm_state,
+                state_partition_specs(comm_state),
+            )
+
         if shard_weight_update:
             from jax.sharding import NamedSharding
 
@@ -559,6 +586,16 @@ def run_training(
                 params=jax.device_put(state.params, rep),
                 batch_stats=jax.device_put(state.batch_stats, rep),
                 opt_state=opt_state,
+                comm_state=_place_comm_state(state.comm_state),
+            )
+        elif getattr(state, "comm_state", ()):
+            rep = replicated_sharding(mesh)
+            state = state.replace(
+                step=jax.device_put(state.step, rep),
+                params=jax.device_put(state.params, rep),
+                batch_stats=jax.device_put(state.batch_stats, rep),
+                opt_state=jax.device_put(state.opt_state, rep),
+                comm_state=_place_comm_state(state.comm_state),
             )
         else:
             state = jax.device_put(state, replicated_sharding(mesh))
@@ -667,6 +704,7 @@ def run_training(
                             anchor_config=anchor_config,
                             shard_weight_update=shard_weight_update,
                             quantized_allreduce=quantized_allreduce,
+                            comm=comm,
                             numerics=numerics_config,
                         )
                     # No process may enter the step's collectives while a
@@ -815,6 +853,17 @@ def run_training(
                     replica_agreement=scalars.get(
                         numerics_lib.REPLICA_AGREEMENT
                     ),
+                )
+                # Comm/EF health record site (ISSUE 13; one bool check
+                # while telemetry is off, absent keys skipped): feeds
+                # the train_ef_residual/saturation gauges the always-
+                # armed ef_residual_spike SLO rule watches, plus the
+                # cumulative bytes-on-wire counter.
+                telemetry.record_comm(
+                    ef_residual=scalars.get(numerics_lib.EF_RESIDUAL),
+                    ef_saturation=scalars.get(numerics_lib.EF_SATURATION),
+                    compressed_bytes=scalars.get(numerics_lib.COMM_BYTES),
+                    steps=window_steps,
                 )
                 if config.numerics:
                     num_keys = numerics_lib.numerics_metric_keys(scalars)

@@ -43,6 +43,13 @@ class TrainState:
     opt_state: Any
     # Static (non-pytree) fields:
     tx: optax.GradientTransformation = flax.struct.field(pytree_node=False)
+    # Comm subsystem state (ISSUE 13): gradient-compression error-feedback
+    # residuals, keyed per bucket (DP) or per leaf (ZeRO) — flat arrays
+    # sharded over the data axis like ZeRO optimizer state, and
+    # checkpointed/resharded the same way (comm/compress.py).  Empty for
+    # every run without compression, in which case it contributes no
+    # pytree leaves and the compiled step is unchanged.
+    comm_state: Any = ()
 
     def apply_gradients(
         self,

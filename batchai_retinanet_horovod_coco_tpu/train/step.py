@@ -176,6 +176,37 @@ def _make_local_step(model, anchors, loss_config, matching_config):
     return local_step
 
 
+def _cached_step_entry(make_step: Callable) -> Callable:
+    """Lazy per-structure compile cache + AOT surface, shared by the
+    ZeRO and comm step flavors.
+
+    The shard_map spec trees depend on the state's tree structure, which
+    only the caller's state knows — so the step is built lazily per
+    structure and cached, keyed on the treedefs (a structurally
+    different state, e.g. a swapped optimizer or a comm-policy change,
+    gets fresh partition specs instead of stale ones).  The ``.lower``
+    attribute is the AOT point the loop's multi-process compile barrier
+    uses (train/loop.py::_compile_barrier)."""
+    cache: dict[Any, Callable] = {}
+
+    def get_step(state: TrainState) -> Callable:
+        key = (
+            jax.tree.structure(state.opt_state),
+            jax.tree.structure(state.params),
+            jax.tree.structure(state.batch_stats),
+            jax.tree.structure(state.comm_state),
+        )
+        if key not in cache:
+            cache[key] = make_step(state)
+        return cache[key]
+
+    def entry(state: TrainState, batch: dict[str, Any]):
+        return get_step(state)(state, batch)
+
+    entry.lower = lambda state, batch: get_step(state).lower(state, batch)
+    return entry
+
+
 def _global_math_step(local_step, numerics: NumericsConfig | None = None):
     """Plain global-batch step body: grads → metrics → update.
 
@@ -228,6 +259,7 @@ def make_train_step(
     donate_state: bool = True,
     shard_weight_update: bool = False,
     quantized_allreduce: bool = False,
+    comm=None,
     numerics: NumericsConfig | None = None,
 ) -> Callable[[TrainState, dict[str, Any]], tuple[TrainState, dict[str, jnp.ndarray]]]:
     """Build the jitted train step for one shape bucket.
@@ -247,11 +279,26 @@ def make_train_step(
     ``make_optimizer(..., shard_clip_axis=DATA_AXIS)`` so gradient clipping
     uses the global (cross-shard) norm.
 
-    ``quantized_allreduce`` (requires ``mesh``, exclusive with
-    ``shard_weight_update``): the gradient all-reduce compresses its gather
-    phase to int8 (parallel/quantize.py) — ~5/8 the ICI traffic of the f32
-    all-reduce, error bounded by one rounding of the already-reduced
-    gradient.  SURVEY.md §5.8's optional EQuARX-style optimization.
+    ``comm`` (a ``comm.CommConfig``; requires ``mesh``): the gradient-
+    communication policy (ISSUE 13).  On the plain-DP path the all-reduce
+    becomes the bucketed, error-feedback int8/bf16 scheme of
+    ``comm/compress.py`` (exact f32 reduce-scatter, EF add-back from
+    ``state.comm_state``, per-block compressed gather; with
+    ``comm.overlap`` each schedule stage's collective is issued inside
+    the backward via ``comm/overlap.py``).  Combined with
+    ``shard_weight_update`` the gradient reduce-scatter stays exact and
+    the compression moves to the ZeRO param gather (quantized UPDATE
+    gather with per-leaf EF — the old exclusivity is lifted).  The
+    pre-clip ``grad_norm`` is computed on the DEQUANTIZED gradients, so
+    the clip chain acts on the values the optimizer actually consumes.
+    EF health lands in the metrics (``ef_residual_norm`` /
+    ``ef_saturation`` / ``comm_compressed_bytes``).  With ``comm`` unset
+    (or ``compress="none"``) the compiled step is byte-identical to the
+    pre-ISSUE-13 program.
+
+    ``quantized_allreduce``: DEPRECATED alias for
+    ``comm=CommConfig(compress="int8")`` (stateless unless the state
+    carries EF residuals) — the pre-ISSUE-13 per-leaf path is gone.
 
     ``numerics`` (obs/numerics.py): enable the fused in-step numerics
     summary — update/param ratio, non-finite gradient count, per-layer-
@@ -267,11 +314,27 @@ def make_train_step(
         raise ValueError("shard_weight_update requires a mesh")
     if quantized_allreduce and mesh is None:
         raise ValueError("quantized_allreduce requires a mesh")
-    if quantized_allreduce and shard_weight_update:
-        raise ValueError(
-            "quantized_allreduce and shard_weight_update are exclusive "
-            "(ZeRO already reduce-scatters; its gather carries params, "
-            "whose quantization would bias the model, not a gradient)"
+    if quantized_allreduce and comm is None:
+        # Deprecated alias (ISSUE 13): the bool maps onto the comm
+        # subsystem's int8 policy.  EF engages iff the caller's state
+        # carries comm residuals (comm.init_comm_state).
+        from batchai_retinanet_horovod_coco_tpu.comm import CommConfig
+
+        comm = CommConfig(compress="int8")
+    comm_on = comm is not None and comm.enabled
+    if comm_on and mesh is None:
+        raise ValueError("comm compression requires a mesh")
+    if comm_on and comm.overlap and shard_weight_update:
+        # The ZeRO flavor's compressed collective is the POST-update
+        # gather — there is no backward-stage collective for overlap to
+        # move.  Warn loudly rather than let the flag silently no-op.
+        import warnings
+
+        warnings.warn(
+            "comm.overlap has no effect with shard_weight_update: the "
+            "ZeRO path compresses the post-update gather, not the "
+            "backward-pass gradient collectives (comm/overlap.py is a "
+            "DP-path mechanism)"
         )
     anchors = jnp.asarray(
         anchors_lib.anchors_for_image_shape(image_hw, anchor_config or anchors_lib.AnchorConfig())
@@ -295,6 +358,11 @@ def make_train_step(
     if shard_weight_update:
         from batchai_retinanet_horovod_coco_tpu.parallel import zero
 
+        if comm_on:
+            from batchai_retinanet_horovod_coco_tpu.comm import (
+                compress as compress_lib,
+            )
+
         def reduce_metrics(metrics):
             num_pos = lax.psum(metrics["num_pos"], DATA_AXIS)
             metrics = lax.pmean(metrics, DATA_AXIS)
@@ -302,17 +370,26 @@ def make_train_step(
             return metrics
 
         def state_specs(state: TrainState) -> TrainState:
-            """Per-leaf spec tree: everything replicated except opt_state."""
+            """Per-leaf spec tree: everything replicated except opt_state
+            (and the comm EF residuals, which shard the same way)."""
             return TrainState(
                 step=P(),
                 params=jax.tree.map(lambda _: P(), state.params),
                 batch_stats=jax.tree.map(lambda _: P(), state.batch_stats),
                 opt_state=zero.opt_state_partition_specs(state.opt_state),
                 tx=state.tx,
+                comm_state=jax.tree.map(
+                    lambda _: P(DATA_AXIS), state.comm_state
+                ),
             )
 
         def make_zero_step(state_template: TrainState):
             specs = state_specs(state_template)
+            zplan = (
+                compress_lib.plan_buckets(state_template.params, comm)
+                if comm_on
+                else None
+            )
 
             @partial(
                 shard_map,
@@ -337,6 +414,31 @@ def make_train_step(
                     new_bs = lax.pmean(new_bs, DATA_AXIS)
                 # Reduce-scatter + sharded update + all_gather replaces the
                 # pmean-allreduce + replicated update (parallel/zero.py).
+                # Comm-on (ISSUE 13): the gradient reduce-scatter stays
+                # exact (it feeds the sharded optimizer and the global
+                # clip norm); the f32 param gather is replaced by the
+                # bucketed compressed UPDATE gather with per-leaf EF
+                # residuals (comm/compress.zero_gather_updates).
+                comm_out: dict[str, Any] = {}
+                gather = None
+                if comm_on:
+                    comm_cs = (
+                        state.comm_state
+                        if isinstance(state.comm_state, dict)
+                        else {}
+                    )
+
+                    def gather(updates, params):
+                        new_p, new_res, sat = (
+                            compress_lib.zero_gather_updates(
+                                updates, params, comm_cs, zplan, comm,
+                                DATA_AXIS, mesh.size,
+                            )
+                        )
+                        comm_out["res"] = new_res
+                        comm_out["sat"] = sat
+                        return new_p
+
                 new_params, new_opt, info = zero.sharded_update(
                     state.tx,
                     grads,
@@ -344,6 +446,7 @@ def make_train_step(
                     state.params,
                     n=mesh.size,
                     loss_value=metrics["loss"],
+                    gather_updates=gather,
                 )
                 metrics.update(info)
                 # Post-update param norm (see the single-device step): the
@@ -371,11 +474,22 @@ def make_train_step(
                             metrics[f"gnorm/{key}"] = lax.pmean(
                                 norm, DATA_AXIS
                             )
+                new_comm_state = state.comm_state
+                if comm_on:
+                    metrics.update(
+                        compress_lib.comm_metrics(
+                            zplan, comm_out["res"], comm_out["sat"],
+                            DATA_AXIS, mesh.size, zero=True,
+                        )
+                    )
+                    if isinstance(state.comm_state, dict):
+                        new_comm_state = comm_out["res"]
                 new_state = state.replace(
                     step=state.step + 1,
                     params=new_params,
                     batch_stats=new_bs,
                     opt_state=new_opt,
+                    comm_state=new_comm_state,
                 )
                 return new_state, metrics
 
@@ -383,31 +497,129 @@ def make_train_step(
                 zero_step, donate_argnums=(0,) if donate_state else ()
             )
 
-        # The spec tree depends on the state's tree structure, which only the
-        # caller's state knows — build lazily per structure and cache, keyed
-        # on the treedefs so a structurally different state (e.g. a swapped
-        # optimizer) gets fresh partition specs instead of stale ones.
-        cache: dict[Any, Callable] = {}
+        return _cached_step_entry(make_zero_step)
 
-        def get_step(state: TrainState) -> Callable:
-            key = (
-                jax.tree.structure(state.opt_state),
-                jax.tree.structure(state.params),
-                jax.tree.structure(state.batch_stats),
-            )
-            if key not in cache:
-                cache[key] = make_zero_step(state)
-            return cache[key]
-
-        def zero_entry(state: TrainState, batch: dict[str, Any]):
-            return get_step(state)(state, batch)
-
-        # AOT surface for the loop's multi-process compile barrier
-        # (train/loop.py::_compile_barrier): compile without executing.
-        zero_entry.lower = lambda state, batch: get_step(state).lower(
-            state, batch
+    if comm_on:
+        # Comm subsystem path (ISSUE 13): bucketed compressed all-reduce
+        # with error feedback, optionally staged inside the backward pass
+        # (comm/overlap.py).  A separate shard_map flavor — the exact
+        # path below stays byte-identical to pre-ISSUE-13.
+        from batchai_retinanet_horovod_coco_tpu.comm import (
+            compress as compress_lib,
         )
-        return zero_entry
+        from batchai_retinanet_horovod_coco_tpu.comm import (
+            overlap as overlap_lib,
+        )
+
+        def make_comm_step(state_template: TrainState):
+            plan = compress_lib.plan_buckets(state_template.params, comm)
+            spec = TrainState(
+                step=P(),
+                params=jax.tree.map(lambda _: P(), state_template.params),
+                batch_stats=jax.tree.map(
+                    lambda _: P(), state_template.batch_stats
+                ),
+                opt_state=jax.tree.map(
+                    lambda _: P(), state_template.opt_state
+                ),
+                tx=state_template.tx,
+                comm_state=jax.tree.map(
+                    lambda _: P(DATA_AXIS), state_template.comm_state
+                ),
+            )
+            grad_fn = (
+                overlap_lib.make_overlap_grad_fn(
+                    plan, comm, DATA_AXIS, mesh.size
+                )
+                if comm.overlap
+                else None
+            )
+
+            @partial(
+                shard_map,
+                mesh=mesh,
+                in_specs=(spec, batch_spec),
+                out_specs=(spec, P()),
+                check_vma=False,
+            )
+            def comm_step(state: TrainState, batch: dict[str, Any]):
+                comm_cs = (
+                    state.comm_state
+                    if isinstance(state.comm_state, dict)
+                    else {}
+                )
+                if comm.overlap:
+                    # Each stage's compressed collective fires inside
+                    # the backward via the custom-vjp taps; the grads
+                    # come out ALREADY reduced and the EF residuals /
+                    # saturation ride the cotangent channel.  (The
+                    # replica-agreement probe needs local pre-reduce
+                    # grads, which this schedule never materializes as
+                    # one tree — structurally absent here.)
+                    def loss_of_params(p):
+                        return _forward_and_loss(
+                            model, state, p,
+                            batch["images"], batch["gt_boxes"],
+                            batch["gt_labels"], batch["gt_mask"],
+                            anchors, loss_config, matching_config,
+                            train=True,
+                        )
+
+                    (_, (metrics, new_bs)), grads, new_comm, sat = (
+                        grad_fn(loss_of_params, state.params, comm_cs)
+                    )
+                else:
+                    grads, metrics, new_bs = local_step(state, batch)
+                    if numerics.enabled and numerics.replica_agreement:
+                        metrics["replica_agreement"] = (
+                            numerics_lib.replica_agreement(
+                                optax.global_norm(grads), DATA_AXIS
+                            )
+                        )
+                    # One fused pass: exact f32 reduce-scatter + EF
+                    # add-back + compressed gather per bucket.
+                    grads, new_comm, sat = compress_lib.reduce_tree(
+                        grads, comm_cs, plan, comm, DATA_AXIS, mesh.size
+                    )
+                num_pos = lax.psum(metrics["num_pos"], DATA_AXIS)
+                metrics = lax.pmean(metrics, DATA_AXIS)
+                metrics["num_pos"] = num_pos
+                # Pre-clip global norm of the DEQUANTIZED gradients —
+                # the values the optimizer actually consumes — shared
+                # with the clip chain (clip_by_global_norm_precomputed).
+                gnorm = optax.global_norm(grads)
+                metrics["grad_norm"] = gnorm
+                if state.batch_stats:
+                    new_bs = lax.pmean(new_bs, DATA_AXIS)
+                new_state = state.apply_gradients(
+                    grads, new_bs, loss_value=metrics["loss"],
+                    grad_norm=gnorm,
+                )
+                metrics["param_norm"] = optax.global_norm(new_state.params)
+                metrics.update(
+                    compress_lib.comm_metrics(
+                        plan, new_comm, sat, DATA_AXIS, mesh.size
+                    )
+                )
+                if isinstance(state.comm_state, dict):
+                    new_state = new_state.replace(comm_state=new_comm)
+                if numerics.enabled:
+                    metrics.update(
+                        numerics_lib.step_summary(
+                            grads, state.params, new_state.params,
+                            metrics["param_norm"], numerics,
+                        )
+                    )
+                return new_state, metrics
+
+            return jax.jit(
+                comm_step, donate_argnums=(0,) if donate_state else ()
+            )
+
+        # Lazy per-structure cache + AOT surface, shared with the ZeRO
+        # flavor: the comm-state tree structure is the caller's (empty
+        # for the stateless deprecated alias, per-bucket dict with EF).
+        return _cached_step_entry(make_comm_step)
 
     @partial(
         shard_map,
@@ -425,14 +637,8 @@ def make_train_step(
             metrics["replica_agreement"] = numerics_lib.replica_agreement(
                 optax.global_norm(grads), DATA_AXIS
             )
-        # THE allreduce: Horovod's NCCL ring → one compiled pmean over ICI
-        # (optionally with an int8-compressed gather phase).
-        if quantized_allreduce:
-            from batchai_retinanet_horovod_coco_tpu.parallel import quantize
-
-            grads = quantize.quantized_pmean(grads, DATA_AXIS, mesh.size)
-        else:
-            grads = lax.pmean(grads, DATA_AXIS)
+        # THE allreduce: Horovod's NCCL ring → one compiled pmean over ICI.
+        grads = lax.pmean(grads, DATA_AXIS)
         num_pos = lax.psum(metrics["num_pos"], DATA_AXIS)  # a count, not a mean
         metrics = lax.pmean(metrics, DATA_AXIS)
         metrics["num_pos"] = num_pos

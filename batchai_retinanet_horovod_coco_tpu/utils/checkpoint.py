@@ -144,12 +144,18 @@ def _chaos_point(phase: str) -> None:
 
 
 def _saveable(state: TrainState) -> dict[str, Any]:
-    """The pytree that goes to disk (drops the static optax transform)."""
+    """The pytree that goes to disk (drops the static optax transform).
+
+    ``comm_state`` (ISSUE 13: gradient-compression EF residuals) rides
+    along; it is ``()`` — zero leaves, manifest unchanged — for every
+    run without compression, so pre-ISSUE-13 checkpoints and
+    uncompressed runs keep the exact same on-disk leaf set."""
     return {
         "step": state.step,
         "params": state.params,
         "batch_stats": state.batch_stats,
         "opt_state": state.opt_state,
+        "comm_state": getattr(state, "comm_state", ()),
     }
 
 
@@ -682,8 +688,20 @@ class CheckpointManager:
         template = _saveable(state)
         flat, treedef = jax.tree_util.tree_flatten_with_path(template)
         t_paths = [jax.tree_util.keystr(p) for p, _ in flat]
+        is_comm = lambda p: p.startswith("['comm_state']")  # noqa: E731
         missing = [p for p in t_paths if p not in saved]
         extra = [p for p in saved if p not in set(t_paths)]
+        # Comm EF residuals (ISSUE 13) are ADVISORY state: a template/
+        # checkpoint mismatch there (compression newly enabled, mode or
+        # bucket layout changed, or a ZeRO<->DP switch re-keying the
+        # residuals) must not refuse a restore — the residuals reset to
+        # zero (EF re-converges in a handful of steps) and exactly ONE
+        # structured ef_reset event says so.  Params/optimizer mismatch
+        # still refuses loudly below.
+        comm_missing = {p for p in missing if is_comm(p)}
+        comm_dropped = [p for p in extra if is_comm(p)]
+        missing = [p for p in missing if not is_comm(p)]
+        extra = [p for p in extra if not is_comm(p)]
         if missing or extra:
             raise ValueError(
                 f"checkpoint {ckpt_dir} does not match this model/"
@@ -691,12 +709,31 @@ class CheckpointManager:
                 f"{'...' if len(missing) > 5 else ''}, unexpected leaves "
                 f"{extra[:5]}{'...' if len(extra) > 5 else ''}"
             )
+        comm_reset = sorted(comm_missing)
         out = []
         for path, leaf in zip(t_paths, (l for _, l in flat)):
-            arr = saved[path]
             shape = tuple(int(d) for d in np.shape(leaf))
             dtype = np.dtype(getattr(leaf, "dtype", np.asarray(leaf).dtype))
+            if path in comm_missing:
+                out.append(np.zeros(shape, dtype))
+                continue
+            arr = saved[path]
+            if is_comm(path):
+                # Comm residuals reshard like ZeRO slots (same flat
+                # padding-is-zeros layout) — but they are ADVISORY: a
+                # re-lay that would drop real content (bucket size
+                # changed under the same key) zeroes the leaf instead
+                # of refusing the restore, counted into the single
+                # ef_reset record below.
+                try:
+                    out.append(reshard_flat_leaf(arr, shape, dtype, path))
+                except ValueError:
+                    out.append(np.zeros(shape, dtype))
+                    comm_reset.append(path)
+                continue
             if path.startswith("['opt_state']"):
+                # Flat ZeRO-layout optimizer slots re-lay into the
+                # template's world size; dropping real data REFUSES.
                 out.append(reshard_flat_leaf(arr, shape, dtype, path))
                 continue
             if arr.shape != shape or arr.dtype != dtype:
@@ -706,6 +743,8 @@ class CheckpointManager:
                     "checkpointed here"
                 )
             out.append(arr)
+        if comm_reset or comm_dropped:
+            self._announce_ef_reset(ckpt_dir, comm_reset, comm_dropped)
         restored = jax.tree_util.tree_unflatten(treedef, out)
         return dataclasses.replace(
             state,
@@ -713,7 +752,34 @@ class CheckpointManager:
             params=restored["params"],
             batch_stats=restored["batch_stats"],
             opt_state=restored["opt_state"],
+            comm_state=restored["comm_state"],
         )
+
+    def _announce_ef_reset(
+        self, ckpt_dir: str, zeroed: list, dropped: list
+    ) -> None:
+        """ONE structured ef_reset record per restore: the EF residual
+        state could not be carried over (see restore()) and was zeroed/
+        dropped — visible in metrics.jsonl (sink) and on stderr."""
+        payload = {
+            "event": "ef_reset",
+            "dir": ckpt_dir,
+            "zeroed": len(zeroed),
+            "dropped": len(dropped),
+            "reason": (
+                "checkpoint comm_state does not match this run's comm "
+                "policy/layout; error-feedback residuals reset to zero "
+                "(EF re-converges within a few steps)"
+            ),
+        }
+        print(json.dumps(payload), file=sys.stderr, flush=True)
+        event = getattr(self._sink, "event", None)
+        if event is not None:
+            try:
+                fields = {k: v for k, v in payload.items() if k != "event"}
+                event("ef_reset", **fields)
+            except Exception:
+                pass  # a broken sink must not fail the restore
 
     def restore_arrays(self, step: int | None = None) -> dict[str, Any]:
         """The saved tree as nested host dicts, no template needed.

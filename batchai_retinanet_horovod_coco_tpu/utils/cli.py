@@ -80,6 +80,88 @@ def add_data_pipeline_flags(parser) -> None:
                              "buffering); 0 = synchronous transfer")
 
 
+def add_comm_flags(parser) -> None:
+    """The gradient-communication flag surface (ISSUE 13, train.py).
+
+    One definition so the chaos harness, COMMBENCH sweep, and any future
+    tool that grows a compressed collective expose identical knobs.
+    ``--quantized-allreduce`` (train.py) survives as a deprecated alias
+    that maps onto ``--comm-compress int8`` with one structured
+    deprecation warning (``make_comm_config``)."""
+    parser.add_argument("--comm-compress", default="none",
+                        choices=["none", "int8", "bf16"],
+                        help="gradient-compression wire format "
+                             "(comm/compress.py): int8 = bucketed "
+                             "per-block symmetric int8 with error "
+                             "feedback (~5/8 the exact bytes-on-wire), "
+                             "bf16 = round-to-nearest bf16 (~3/4); the "
+                             "reduce phase stays exact f32 either way.  "
+                             "Composes with --shard-weight-update (the "
+                             "compression moves to the ZeRO update "
+                             "gather).  none = byte-identical "
+                             "pre-ISSUE-13 step")
+    parser.add_argument("--comm-overlap", action="store_true",
+                        help="issue each schedule stage's (backbone/fpn/"
+                             "heads) compressed collective from INSIDE "
+                             "the backward pass (comm/overlap.py "
+                             "custom-vjp staging) instead of one fused "
+                             "pass after it; identical values, earlier "
+                             "wire time.  DP path only: with "
+                             "--shard-weight-update the compression is "
+                             "the post-update gather and this flag is "
+                             "ignored with a structured warning")
+    parser.add_argument("--comm-bucket-mb", type=float, default=4.0,
+                        help="bucket capacity in MB: leaves pack per "
+                             "stage into flat buckets of this size so "
+                             "small leaves share one quantized "
+                             "collective; a bucket under "
+                             "min_bucket_bytes stays exact")
+    parser.add_argument("--comm-no-error-feedback", action="store_true",
+                        help="disable the error-feedback residual "
+                             "(comm state): quantization error is then "
+                             "dropped each step instead of carried — "
+                             "debugging/ablation only")
+
+
+def make_comm_config(args):
+    """CommConfig (or None) from the flags above + the deprecated
+    ``--quantized-allreduce`` alias.  The alias maps onto the comm
+    subsystem with ONE structured deprecation warning on stderr — the
+    behavior change (bucketed + EF instead of per-leaf, no EF) is
+    announced, never silent."""
+    import json as _json
+    import sys as _sys
+
+    from batchai_retinanet_horovod_coco_tpu.comm import CommConfig
+
+    compress = getattr(args, "comm_compress", "none") or "none"
+    if getattr(args, "quantized_allreduce", False):
+        if compress == "none":
+            compress = "int8"
+        print(
+            _json.dumps({
+                "event": "deprecated_flag",
+                "flag": "--quantized-allreduce",
+                "mapped_to": f"--comm-compress {compress}",
+                "note": (
+                    "the per-leaf quantized allreduce was subsumed by "
+                    "the comm/ subsystem (bucketed, error-feedback; "
+                    "ISSUE 13) — switch to --comm-compress"
+                ),
+            }),
+            file=_sys.stderr, flush=True,
+        )
+    overlap = bool(getattr(args, "comm_overlap", False))
+    if compress == "none" and not overlap:
+        return None
+    return CommConfig(
+        compress=compress,
+        overlap=overlap,
+        bucket_mb=float(getattr(args, "comm_bucket_mb", 4.0)),
+        error_feedback=not getattr(args, "comm_no_error_feedback", False),
+    )
+
+
 def add_obs_flags(parser) -> None:
     """The observability flag surface (train.py / evaluate.py; ISSUE 3).
 

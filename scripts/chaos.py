@@ -22,6 +22,12 @@ promises (utils/checkpoint.py):
   with ``--auto-resume`` the run must complete to the target step with
   EXACTLY ONE structured ``auto_resume`` event, a NUMERICS_DUMP.json,
   and the poison batch's image ids excluded from the healed stream.
+- **Comm leg** (``--comm`` / ``make chaos-comm``, ISSUE 13) — SIGKILL a
+  ``--comm-compress int8`` run (2 virtual devices) mid-save; the
+  surviving checkpoint must carry the EF residual leaves, the resume
+  must restore them (or cleanly zero them with ONE structured
+  ``ef_reset`` event), and the resumed losses must rejoin the
+  uninterrupted compressed baseline's envelope.
 - **CKPTBENCH** (``--bench``) — measures the two durability numbers the
   ROADMAP asks for: save overhead (wall time of N checkpointed steps vs
   the same N without) and time-to-first-step on resume; writes
@@ -337,6 +343,112 @@ def _nan_leg(steps: int = 12, inject_at: int = 7) -> None:
             f"poison step {inject_at}",
         )
     if not _failures:
+        shutil.rmtree(work, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# Comm leg (ISSUE 13): SIGKILL under gradient compression + error feedback
+# ---------------------------------------------------------------------------
+#
+# The EF residual is TRAINING STATE: it carries the quantization error the
+# next step must add back, so a crash/restore cycle that silently dropped
+# it would re-bias the compressed gradients with nothing in the logs.
+# This leg kills a real --comm-compress int8 CPU run (2 virtual devices —
+# compression rides the mesh collectives) mid-save and asserts the
+# durability contract: the checkpoint carries ['comm_state'] leaves, the
+# resume either restores them or cleanly zeros them with EXACTLY ONE
+# structured ef_reset event, and the resumed losses rejoin the
+# uninterrupted compressed baseline's envelope.
+
+
+def _comm_cmd(work: str, steps: int) -> list[str]:
+    cmd = _base_cmd(
+        work, steps, ["--resume-elastic", "--comm-compress", "int8"]
+    )
+    # Compression needs a mesh: 2 virtual CPU devices (train.py forces
+    # xla_force_host_platform_device_count in the subprocess).
+    i = cmd.index("--num-devices")
+    cmd[i + 1] = "2"
+    return cmd
+
+
+def _comm_leg(steps: int = 8) -> None:
+    from batchai_retinanet_horovod_coco_tpu.utils.checkpoint import (
+        read_manifest,
+    )
+
+    # Uninterrupted compressed baseline (its own losses — int8+EF drifts
+    # from the exact run by design, so the envelope is compressed-vs-
+    # compressed).
+    base = _fresh_workdir("comm_base")
+    r = _run(_comm_cmd(base, steps))
+    check(
+        r.returncode == 0,
+        f"comm: baseline failed rc={r.returncode}: {r.stderr[-500:]}",
+    )
+    baseline = _losses_by_step(os.path.join(base, "logs", "metrics.jsonl"))
+    check(
+        baseline.get(steps) is not None,
+        f"comm: baseline never reached step {steps}",
+    )
+
+    work = _fresh_workdir("comm_kill")
+    cmd = _comm_cmd(work, steps)
+    r = _run(cmd, env_extra={"RETINANET_CHAOS_KILL": "tmp_write@2"})
+    check(
+        r.returncode != 0,
+        "comm: mid-save kill never fired (rc 0 — schedule vacuous)",
+    )
+    _validate_ckpt_dir(work, "comm")
+    manifest = read_manifest(os.path.join(work, "ckpt"))
+    check(manifest is not None, "comm: no restorable checkpoint survived")
+    if manifest is not None:
+        has_ef = any(
+            e["path"].startswith("['comm_state']")
+            for e in manifest.get("leaves", [])
+        )
+        check(
+            has_ef,
+            "comm: surviving checkpoint carries no EF residual leaves "
+            "(comm_state was not checkpointed)",
+        )
+    resume = _run(cmd)
+    check(
+        resume.returncode == 0,
+        f"comm: resume failed rc={resume.returncode}: "
+        f"{resume.stderr[-500:]}",
+    )
+    metrics = os.path.join(work, "logs", "metrics.jsonl")
+    ef_resets = _events(metrics, "ef_reset")
+    check(
+        len(ef_resets) <= 1,
+        f"comm: expected 0 (restored) or 1 (cleanly zeroed) ef_reset "
+        f"events, got {len(ef_resets)}",
+    )
+    losses = _losses_by_step(metrics)
+    check(
+        losses.get(steps) is not None,
+        f"comm: resumed run never reached step {steps}",
+    )
+    # Same world size + --resume-elastic: a restore that carried the EF
+    # state replays the baseline essentially exactly (tight envelope);
+    # the announced zero-and-continue path perturbs the first resumed
+    # steps at quantization-error scale, so its envelope is the loose
+    # one — either way the trajectory must rejoin the uninterrupted
+    # compressed baseline.
+    rtol = 5e-2 if ef_resets else 1e-5
+    bad = {
+        s: (losses[s], baseline[s])
+        for s in losses
+        if s in baseline
+        and abs(losses[s] - baseline[s]) > rtol * max(abs(baseline[s]), 1e-9)
+    }
+    check(
+        not bad,
+        f"comm: resumed losses left the baseline envelope: {bad}",
+    )
+    if not _failures:
+        shutil.rmtree(base, ignore_errors=True)
         shutil.rmtree(work, ignore_errors=True)
 
 
@@ -937,6 +1049,12 @@ def main(argv=None) -> int:
                         "200s throughout, breaker reopens after respawn) "
                         "+ the slow-canary rollback leg (exactly one "
                         "canary_rollback, fleet back to baseline)")
+    p.add_argument("--comm", action="store_true",
+                   help="comm leg only (make chaos-comm): SIGKILL a "
+                        "--comm-compress int8 run mid-save; the resume "
+                        "must restore the EF residual state (or cleanly "
+                        "zero it with one structured ef_reset event) and "
+                        "rejoin the uninterrupted compressed baseline")
     p.add_argument("--bench", action="store_true",
                    help="CKPTBENCH: save overhead + time-to-first-step")
     p.add_argument("--check", action="store_true",
@@ -959,6 +1077,14 @@ def main(argv=None) -> int:
 
     if args.serve:
         run_serve_legs()
+        print(json.dumps({
+            "chaos": "ok" if not _failures else "FAIL",
+            "failures": _failures,
+        }), flush=True)
+        return 1 if _failures else 0
+
+    if args.comm:
+        _comm_leg()
         print(json.dumps({
             "chaos": "ok" if not _failures else "FAIL",
             "failures": _failures,
@@ -993,6 +1119,8 @@ def main(argv=None) -> int:
         if not _failures:
             _torn_dir_legs(baseline, steps)
             _nan_leg()
+        if not _failures:
+            _comm_leg()  # compression+EF durability (ISSUE 13)
         if not _failures:
             run_serve_legs()  # the serve-side half of the full schedule
         print(f"# chaos: {kills} scheduled kills executed", flush=True)

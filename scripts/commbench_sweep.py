@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""COMMBENCH sweep driver: capture the committed comm-subsystem record.
+
+Runs ``bench.py --mode comm`` with the full variant sweep (int8, int8 +
+backward overlap, bf16, 1 MB buckets) on the forced virtual CPU mesh and
+writes the committed ``COMMBENCH.json`` artifact — bytes-on-wire vs
+exact, step-time delta, and parity drift at N steps per variant.  The
+regression tripwire is ``make commbench-check`` (``BENCH_CHECK=1
+bench.py --mode comm``), which enforces the <= 0.65 bytes claim and the
+parity-drift band against this artifact.
+
+A SUBPROCESS per invocation, not an import: the comm bench must force
+its virtual mesh before any jax backend initializes, which only a fresh
+interpreter guarantees (the __graft_entry__ constraint).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main() -> int:
+    out = os.path.join(_REPO, "COMMBENCH.json")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["BENCH_SWEEP"] = "1"
+    env["COMMBENCH_OUT"] = out
+    r = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py"), "--mode", "comm"],
+        env=env, cwd=_REPO,
+    )
+    if r.returncode == 0:
+        print(f"commbench sweep complete: {out}")
+    return r.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

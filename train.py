@@ -68,11 +68,13 @@ from batchai_retinanet_horovod_coco_tpu.models.retinanet import (  # noqa: E402
 # Shared with convert_model.py / debug.py — one anchor surface (utils/cli.py).
 from batchai_retinanet_horovod_coco_tpu.utils.cli import (  # noqa: E402
     add_anchor_flags,
+    add_comm_flags,
     add_data_pipeline_flags,
     add_durability_flags,
     add_obs_flags,
     configure_obs,
     make_anchor_config,
+    make_comm_config,
     make_pipeline_worker_kwargs,
     resolve_anchor_config,
     save_anchor_config,
@@ -255,17 +257,22 @@ def build_parser() -> argparse.ArgumentParser:
                             "reduce-scatter grads, 1/N optimizer state per "
                             "device, all_gather params (SURVEY.md §2.4)")
         g.add_argument("--quantized-allreduce", action="store_true",
-                       help="int8-compressed gather phase in the gradient "
-                            "all-reduce: ~5/8 the ICI traffic, error "
-                            "bounded by one rounding of the reduced "
-                            "gradient (SURVEY.md §5.8, parallel/quantize.py)")
+                       help="DEPRECATED alias for --comm-compress int8 "
+                            "(ISSUE 13: the per-leaf quantized allreduce "
+                            "was subsumed by the bucketed, error-feedback "
+                            "comm/ subsystem); emits one structured "
+                            "deprecation warning")
+        # --comm-compress / --comm-overlap / --comm-bucket-mb /
+        # --comm-no-error-feedback: the gradient-communication policy
+        # surface (ISSUE 13, utils/cli.py — shared with chaos/COMMBENCH).
+        add_comm_flags(g)
         g.add_argument("--spatial-shards", type=int, default=1,
                        help="shard every image's H axis over this many "
                             "chips on a 2-D data x space mesh (GSPMD conv "
                             "halo exchanges — the sequence/context-parallel "
                             "analogue, SURVEY.md §5.7); must divide "
                             "--num-devices; exclusive with "
-                            "--shard-weight-update/--quantized-allreduce")
+                            "--shard-weight-update/--comm-compress")
         g.add_argument("--allow-data-axis-divergence", action="store_true",
                        help="accept the measured gradient divergence of "
                             "deep-backbone spatial training on meshes "
@@ -457,7 +464,11 @@ def _start_telemetry(args, logger):
          # Checkpoint staleness (ISSUE 11): silent until two saves have
          # landed (the age/interval gauge needs a measured cadence), so
          # un-checkpointed runs never see it evaluate.
-         slo.ckpt_staleness_rule()]
+         slo.ckpt_staleness_rule(),
+         # Gradient-compression EF health (ISSUE 13): always armed —
+         # silent until the train_ef_residual gauge exists, i.e. on
+         # every run without --comm-compress.
+         slo.ef_residual_spike()]
         + [slo.parse_rule(s) for s in rule_specs],
         sink=logger,
         poll_interval=getattr(args, "slo_poll_s", 5.0),
@@ -701,12 +712,15 @@ def _run(args) -> dict[str, float]:
                 f"--spatial-shards {spatial_shards} must divide "
                 f"--num-devices {num_devices}"
             )
-        if getattr(args, "shard_weight_update", False) or getattr(
-            args, "quantized_allreduce", False
+        if (
+            getattr(args, "shard_weight_update", False)
+            or getattr(args, "quantized_allreduce", False)
+            or getattr(args, "comm_compress", "none") != "none"
+            or getattr(args, "comm_overlap", False)
         ):
             raise SystemExit(
                 "--spatial-shards is exclusive with --shard-weight-update "
-                "and --quantized-allreduce"
+                "and --comm-compress/--comm-overlap/--quantized-allreduce"
             )
         if not args.f32:
             # The SPMD partitioner miscompiles the bf16 spatial train step
@@ -859,13 +873,32 @@ def _run(args) -> dict[str, float]:
     shard_update = bool(getattr(args, "shard_weight_update", False))
     if shard_update and num_devices <= 1:
         raise SystemExit("--shard-weight-update needs --num-devices > 1")
-    quantized = bool(getattr(args, "quantized_allreduce", False))
-    if quantized and num_devices <= 1:
-        raise SystemExit("--quantized-allreduce needs --num-devices > 1")
-    if quantized and shard_update:
+    # Gradient-communication policy (ISSUE 13): flags (+ the deprecated
+    # --quantized-allreduce alias) resolve to ONE CommConfig; composes
+    # with --shard-weight-update (compressed ZeRO update gather — the
+    # old exclusivity is lifted).
+    comm_cfg = make_comm_config(args)
+    if comm_cfg is not None and num_devices <= 1:
         raise SystemExit(
-            "--quantized-allreduce and --shard-weight-update are exclusive"
+            "--comm-compress/--comm-overlap need --num-devices > 1 "
+            "(compression rides the mesh collectives)"
         )
+    if comm_cfg is not None and comm_cfg.overlap and shard_update:
+        # ZeRO compresses the POST-update gather; there is no backward
+        # gradient collective for overlap to restage.  One structured
+        # line, then drop the flag (never a silent no-op).
+        print(
+            json.dumps({
+                "event": "comm_overlap_ignored",
+                "reason": (
+                    "--comm-overlap is a DP-path mechanism; "
+                    "--shard-weight-update compresses the post-update "
+                    "gather instead"
+                ),
+            }),
+            file=sys.stderr, flush=True,
+        )
+        comm_cfg = dataclasses.replace(comm_cfg, overlap=False)
     # Sharded-update mode swaps in the cross-shard global-norm clip — same
     # chain position, same clip value, one source of truth (parallel/zero.py).
     from batchai_retinanet_horovod_coco_tpu.parallel.mesh import DATA_AXIS
@@ -899,6 +932,20 @@ def _run(args) -> dict[str, float]:
             state = state.replace(
                 params=params,
                 opt_state=init_sharded_opt_state(tx, params, mesh),
+            )
+        if comm_cfg is not None and comm_cfg.needs_state and mesh is not None:
+            # Zeroed EF residuals in the layout the step expects (per
+            # bucket for DP, per leaf for ZeRO); host numpy — the loop's
+            # replication block places them data-axis-sharded, and a
+            # checkpoint restore reshards into these shapes.
+            from batchai_retinanet_horovod_coco_tpu.comm import (
+                init_comm_state,
+            )
+
+            state = state.replace(
+                comm_state=init_comm_state(
+                    state.params, comm_cfg, mesh.size, zero=shard_update
+                )
             )
         if args.pretrained_backbone:
             from batchai_retinanet_horovod_coco_tpu.models.import_weights import (
@@ -982,7 +1029,10 @@ def _run(args) -> dict[str, float]:
             # so their shards are non-addressable from one host and
             # device_get would raise; (b) even replicated, it halves the
             # per-eval host<->device traffic (optimizer slots ~= params).
-            eval_state = eval_state.replace(opt_state=())
+            # comm_state (EF residuals) drops with it: detection never
+            # reads it, and under compression its leaves are data-axis-
+            # sharded over the GLOBAL mesh (non-addressable cross-host).
+            eval_state = eval_state.replace(opt_state=(), comm_state=())
             eval_state = jax.device_put(
                 jax.device_get(eval_state), replicated_sharding(eval_mesh)
             )
@@ -1010,7 +1060,7 @@ def _run(args) -> dict[str, float]:
                 )
                 n = eval_mesh.size
                 eval_batch = ((args.batch_size + n - 1) // n) * n
-                eval_state = eval_state.replace(opt_state=())
+                eval_state = eval_state.replace(opt_state=(), comm_state=())
                 eval_state = jax.device_put(
                     eval_state, replicated_sharding(eval_mesh)
                 )
@@ -1181,7 +1231,7 @@ def _run(args) -> dict[str, float]:
                     schedule=schedule,
                     anchor_config=anchor_config,
                     shard_weight_update=shard_update,
-                    quantized_allreduce=quantized,
+                    comm=comm_cfg,
                     allow_data_axis_divergence=args.allow_data_axis_divergence,
                     eval_fn=run_eval_fn,
                     logger=logger,

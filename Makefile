@@ -69,7 +69,7 @@ bench:
 bench-check:
 	BENCH_SWEEP=0 BENCH_NUMERICS=0 BENCH_CHECK=1 python bench.py
 	BENCH_SWEEP=0 EVALBENCH_E2E=0 BENCH_CHECK=1 python bench.py --mode eval
-	BENCH_SWEEP=0 SERVEBENCH_OVERLOAD=0 BENCH_CHECK=1 python bench.py --mode serve
+	BENCH_SWEEP=0 SERVEBENCH_OVERLOAD=0 SERVEBENCH_E2E=0 BENCH_CHECK=1 python bench.py --mode serve
 	$(MAKE) commbench-check
 	$(MAKE) perf-report-check
 	$(MAKE) telemetry-smoke
@@ -90,11 +90,18 @@ evalbench-check:
 # acceptance bar), request p50/p99, and an overload leg proving bounded
 # queues SHED instead of queueing unboundedly.  servebench-check is the
 # regression tripwire (same floor/device-class policy as bench-check).
+# The continuous-vs-deadline leg (ISSUE 14) races the same seeded
+# open-loop mixed-arrival schedule in both batching modes: the capture
+# (servebench) runs it on the live flagship executable with the in-run
+# bit-identity cross-check (SERVEBENCH_E2E=1 default); the check runs
+# the device-independent stub fast path (SERVEBENCH_E2E=0) and enforces
+# occupancy-strictly-above + the p99 no-worse band + the committed
+# occupancy floor.
 servebench:
 	python bench.py --mode serve
 
 servebench-check:
-	BENCH_SWEEP=0 SERVEBENCH_OVERLOAD=0 BENCH_CHECK=1 python bench.py --mode serve
+	BENCH_SWEEP=0 SERVEBENCH_OVERLOAD=0 SERVEBENCH_E2E=0 BENCH_CHECK=1 python bench.py --mode serve
 
 # All four XLA-partitioner canaries in one shot (VERDICT r5 next-round #5):
 # each asserts its bug's PRESENCE on the current jax/XLA (or skips when the

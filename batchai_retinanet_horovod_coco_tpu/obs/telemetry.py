@@ -661,12 +661,14 @@ def default() -> Registry:
 
 
 def reset() -> None:
-    """Test hook: disable and drop the default registry + train handles."""
-    global _default, _train_gauges
+    """Test hook: disable and drop the default registry + train/serve
+    handles."""
+    global _default, _train_gauges, _serve_metrics
     disable()
     with _default_lock:
         _default = None
     _train_gauges = None
+    _serve_metrics = None
     with _ckpt_lock:
         _ckpt_state.update(
             last_success_t=None, interval_s=None, last_save_s=None,
@@ -827,6 +829,56 @@ def record_comm(
         g["ef_saturation"].set(float(ef_saturation))
     if compressed_bytes is not None and math.isfinite(compressed_bytes):
         g["comm_bytes"].inc(float(compressed_bytes) * max(1, int(steps)))
+
+
+_serve_metrics: dict[str, Any] | None = None
+
+
+def _serve_handles() -> dict[str, Any]:
+    """Lazily-created serve batching handles on the default registry
+    (ISSUE 14) — like the train handles, registration is never paid on
+    the disabled path."""
+    global _serve_metrics
+    if _serve_metrics is None:
+        r = default()
+        _serve_metrics = {
+            "occupancy": r.histogram(
+                "serve_batch_occupancy",
+                "per-dispatched-batch device occupancy "
+                "(live rows / padded batch size)",
+            ),
+            "free_slots": r.gauge(
+                "serve_free_slots",
+                "unclaimed slots across the assembling batches at the "
+                "last dispatch (idle device capacity)",
+            ),
+            "slot_wait": r.histogram(
+                "serve_slot_wait_ms",
+                "ms a claimed slot waited between claim and seal "
+                "(continuous in-flight batching admission latency)",
+            ),
+        }
+    return _serve_metrics
+
+
+def record_serve_batch(
+    occupancy: float,
+    free_slots: float,
+    slot_wait_ms=(),
+) -> None:
+    """The serve frontend's per-dispatched-batch record site (ISSUE 14;
+    serve/frontend.py ``_on_batch``).  One bool check while telemetry is
+    off."""
+    if not _enabled:
+        return
+    g = _serve_handles()
+    if math.isfinite(occupancy):
+        g["occupancy"].observe(float(occupancy))
+    if math.isfinite(free_slots):
+        g["free_slots"].set(float(free_slots))
+    for w in slot_wait_ms:
+        if math.isfinite(w):
+            g["slot_wait"].observe(float(w))
 
 
 def record_nonfinite_trip(metric: str) -> None:

@@ -3,17 +3,22 @@
 The consumer of ``evaluate/export.py``'s StableHLO artifacts (and of live
 params via the same compiled-detect path): requests are decoded/resized on
 host worker threads with the input pipeline's own geometry, routed into
-per-bucket queues, coalesced into padded batches under a max-latency
-deadline, dispatched one-behind on device, and de-padded back to
+per-bucket SLOT POOLS (ISSUE 14: continuous in-flight batching — a
+request claims a slot in the batch being assembled up to the moment it
+dispatches, and a partial batch seals the instant the device is ready,
+with the classic deadline-only coalescing kept as ``continuous=False``),
+dispatched one-behind on device, and de-padded ROW BY ROW back to
 per-request COCO-style detections that are bit-identical to
 ``run_coco_eval``'s (PARITY.md).
 
 Layers (one module each; RUNBOOK §10 is the operator guide):
 
 - ``common``   — config, request/future lifecycle, error taxonomy, stats
-- ``engine``   — (bucket, batch) executable table + one-behind dispatcher
+- ``engine``   — (bucket, batch) executable table + continuous one-behind
+  dispatcher and the device-readiness ``DispatchGate``
 - ``router``   — host preprocess workers (decode → resize → bucket-route)
-- ``batcher``  — per-bucket coalescing under the latency deadline
+- ``batcher``  — per-bucket slot-pool admission (continuous seal-on-ready
+  or deadline-only coalescing)
 - ``frontend`` — ``DetectionServer`` (admission/shedding/drain), the
   stdlib HTTP frontend, and the ``python -m …serve`` CLI
 - ``replica``  — uniform replica handles (in-process / HTTP subprocess)

@@ -1,22 +1,37 @@
-"""Per-bucket dynamic batcher: coalesce under a max-latency deadline.
+"""Per-bucket slot-pool batcher: continuous in-flight admission (ISSUE 14).
 
 One ``BucketBatcher`` thread per shape bucket pulls preprocessed requests
-from its bounded queue and coalesces them into padded device-ready
-batches:
+from its bounded queue and claims them into a ``SlotPool`` — the batch
+currently being ASSEMBLED.  A request admitted one tick after a batch
+dispatched no longer waits a full deadline+round: it claims a free slot in
+the assembling batch and rides the next seal.  The pool seals (assembles a
+padded device batch and hands it to the dispatcher) when:
 
-- a batch FIRES when it reaches the bucket's largest compiled batch size,
-  or when ``max_delay_ms`` has elapsed since its FIRST request arrived —
-  the classic dynamic-batching deadline: under saturation batches fill
-  instantly and the deadline never fires; under light load a lone request
-  waits at most one deadline before running (padded, or at a smaller
-  exported batch size when the engine has one);
-- expired requests (per-request deadline) are rejected at collection time
-  and never occupy a batch row;
+- it is FULL (every slot of the bucket's largest compiled batch claimed);
+- the coalescing deadline (``max_delay_ms`` since the FIRST claim) fires —
+  the classic dynamic-batching latency bound, alive in both modes;
+- **continuous mode only**: the dispatch gate reports the device is ready
+  (batch N's results just landed, or the device is idle) — a partial
+  batch rides immediately instead of padding out the deadline, so the
+  device never idles waiting for a "full" batch and a row's latency is
+  bounded by one in-flight round.
+
+``continuous=False`` (``ServeConfig``) keeps the deadline-only seal set
+{full, deadline} — the pre-ISSUE-14 behavior, same slot pool underneath.
+
+Other contracts, unchanged from the deadline-only ancestor:
+
+- expired requests are rejected at claim time, and a claimed request
+  whose deadline expires before the seal is EVICTED at the dispatch
+  window — the eviction frees its slot atomically under the pool lock
+  (an eviction racing the seal can never leave an orphaned claimed slot,
+  nor a dead row riding the device);
 - assembly reuses the input pipeline's pad template (`_pad_template`) and
   row layout (image at the top-left corner, dataset-mean pad margins) so
   a served image's batch row is byte-identical to the row the eval
   pipeline's ``_assemble`` would build — the other half of the
-  bit-identity contract (router docstring has the resize half);
+  bit-identity contract (router docstring has the resize half).  Slot
+  timing changes WHEN rows ride, never what they compute (PARITY §5.9);
 - the handoff to the dispatcher is a bounded stop-gated put: a slow
   device backpressures the batcher (watchdog ``idle()``, not a stall),
   and queue bounds upstream convert sustained overload into sheds.
@@ -44,10 +59,90 @@ from batchai_retinanet_horovod_coco_tpu.serve.common import (
 )
 
 
+class SlotPool:
+    """The batch currently being assembled for one bucket, slot-granular.
+
+    ``claim()`` takes a free slot; ``seal()`` atomically evicts expired
+    claims and takes every live row (resetting the pool) under ONE lock
+    acquisition, so an expired-deadline eviction racing the dispatch
+    window can neither orphan a claimed slot nor leak a dead row into
+    the sealed batch.  ``now_fn`` is injectable for race-shaped tests
+    (tests/unit/test_serve.py) — production uses the obs clock.
+    """
+
+    def __init__(self, capacity: int, now_fn: Callable[[], float] = monotonic_s):
+        self.capacity = max(1, int(capacity))
+        self._now = now_fn
+        self._lock = threading.Lock()
+        self._rows: list[ServeRequest] = []
+        self._claim_t: list[float] = []
+        self.first_claim_t: float | None = None
+        self.evictions = 0
+
+    def claim(self, req: ServeRequest) -> bool:
+        """Claim one free slot for ``req``; False when the pool is full
+        (the caller seals first, then re-claims)."""
+        with self._lock:
+            if len(self._rows) >= self.capacity:
+                return False
+            now = self._now()
+            if not self._rows:
+                self.first_claim_t = now
+            self._rows.append(req)
+            self._claim_t.append(now)
+            return True
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._rows)
+
+    def free_slots(self) -> int:
+        with self._lock:
+            return self.capacity - len(self._rows)
+
+    def fire_deadline(self, max_delay_s: float) -> float | None:
+        """When the coalescing deadline fires for the current assembly
+        (None while the pool is empty)."""
+        with self._lock:
+            if self.first_claim_t is None:
+                return None
+            return self.first_claim_t + max_delay_s
+
+    def seal(
+        self, on_evict: Callable[[ServeRequest, BaseException], None]
+    ) -> tuple[list[ServeRequest], list[float]]:
+        """Atomically evict expired claims, take every live row, reset.
+
+        Returns ``(rows, slot_wait_ms)``, row-aligned.  Evicted requests
+        are rejected with ``RequestTimeout`` AFTER the lock is released
+        (callbacks must not run under the pool lock); their slots are
+        already free by then — the no-orphaned-slot contract.
+        """
+        now = self._now()
+        with self._lock:
+            rows, waits, evicted = [], [], []
+            for req, t in zip(self._rows, self._claim_t):
+                if req.expired(now=now):
+                    evicted.append(req)
+                else:
+                    rows.append(req)
+                    waits.append((now - t) * 1e3)
+            self._rows = []
+            self._claim_t = []
+            self.first_claim_t = None
+            self.evictions += len(evicted)
+        for req in evicted:
+            on_evict(req, RequestTimeout(
+                f"request {req.id} expired in its claimed slot"
+            ))
+        return rows, waits
+
+
 def assemble_requests(
     requests: list[ServeRequest],
     hw: tuple[int, int],
     batch_size: int,
+    slot_wait_ms: tuple = (),
 ) -> AssembledBatch:
     """Pad ≤``batch_size`` preprocessed requests into one device batch.
 
@@ -79,13 +174,18 @@ def assemble_requests(
         scales=scales,
         valid=valid,
         t_assembled=monotonic_s(),
+        slot_wait_ms=tuple(slot_wait_ms),
     )
 
 
 class BucketBatcher:
-    """One bucket's coalescing thread."""
+    """One bucket's slot-pool admission thread."""
 
     _POLL_S = 0.05
+    # While slots are claimed the loop polls tightly: a seal must notice
+    # the dispatch gate / deadline within ~one device-dispatch overhead,
+    # not within the idle poll.
+    _ARMED_POLL_S = 0.002
 
     def __init__(
         self,
@@ -97,6 +197,7 @@ class BucketBatcher:
         on_reject: Callable[[ServeRequest, BaseException], None],
         on_fatal: Callable[[BaseException], None],
         stop: threading.Event,
+        gate=None,  # DispatchGate (continuous mode) or None (deadline-only)
     ):
         self.hw = hw
         self._engine = engine
@@ -106,8 +207,12 @@ class BucketBatcher:
         self._on_reject = on_reject
         self._on_fatal = on_fatal
         self._stop = stop
+        self._gate = gate
+        self.pool = SlotPool(engine.max_batch(hw))
         self.batches = 0
         self.deadline_fires = 0
+        self.full_fires = 0
+        self.ready_fires = 0  # continuous seals: the device asked
         # watchdog: registers in _run() at thread start.
         self.thread = threading.Thread(
             target=self._run,
@@ -137,60 +242,158 @@ class BucketBatcher:
                 continue
             return req
 
-    def _collect(self) -> list[ServeRequest] | None:
-        """Block for a first request, then coalesce until full or the
-        max-latency deadline; None when stopping with nothing taken."""
-        first = None
-        while first is None:
-            if self._stop.is_set():
-                return None
-            first = self._take_live(self._POLL_S)
-            self._hb.beat()
-        max_b = self._engine.max_batch(self.hw)
-        batch = [first]
-        fire_at = monotonic_s() + self._max_delay_s
-        while len(batch) < max_b:
-            remaining = fire_at - monotonic_s()
-            if remaining <= 0 or self._stop.is_set():
-                self.deadline_fires += 1
-                break
-            req = self._take_live(remaining)
-            if req is not None:
-                batch.append(req)
-        return batch
+    def _claim(self, req: ServeRequest) -> bool:
+        """Claim + arm: the gate's armed flag tells the dispatcher that
+        a post-fetch handoff wait can actually yield a batch."""
+        ok = self.pool.claim(req)
+        if ok and self._gate is not None:
+            self._gate.arm(self.hw)
+        return ok
+
+    def _drain_claims(self) -> None:
+        """Claim every immediately-available live request up to capacity
+        — the last admission sweep before a seal ("up to the moment it
+        dispatches")."""
+        while self.pool.free_slots() > 0:
+            try:
+                req = self._in.get_nowait()
+            except queue.Empty:
+                return
+            if req.expired():
+                self._on_reject(req, RequestTimeout(
+                    f"request {req.id} expired waiting for a batch"
+                ))
+                continue
+            self._claim(req)
+
+    def _seal_reason(self) -> str | None:
+        """Why the assembling batch should seal NOW, or None.
+
+        Deadline-only mode seals at {full, deadline}.  Continuous mode
+        seals at {full, ready}: the gate is raised every time the device
+        goes idle or a round's results land, so a claimed row waits at
+        most ONE in-flight round — sealing at the deadline while work
+        runs ahead would only freeze the batch partial without making
+        any row ride sooner (the rows dispatch at the same instant
+        either way, just in a smaller batch).  The deadline survives in
+        continuous mode as a stall rescue (gate wedged = a bug, but the
+        pool must never hold rows hostage to it) and as the drain flush.
+        """
+        n = self.pool.size()
+        if n == 0:
+            return None
+        if n >= self.pool.capacity:
+            return "full"
+        now = monotonic_s()
+        fire_at = self.pool.fire_deadline(self._max_delay_s)
+        if self._gate is None:
+            if fire_at is not None and now >= fire_at:
+                return "deadline"
+        else:
+            if self._gate.is_ready() and self._out.empty():
+                return "ready"
+            # UNCONDITIONAL rescue: with multiple buckets sharing the
+            # dispatch queue, a saturated sibling can keep it non-empty
+            # indefinitely — past the rescue point this pool seals into
+            # the queue regardless (the bounded stop-gated put is the
+            # backpressure, exactly as in deadline-only mode), so a
+            # claimed row is never held hostage to another bucket.
+            rescue_at = (fire_at or now) + max(0.1, self._max_delay_s)
+            if now >= rescue_at:
+                return "deadline"
+        if self._stop.is_set():
+            return "deadline"  # draining: flush what is claimed
+        return None
+
+    def _seal_and_dispatch(self, hb, reason: str) -> bool:
+        """Assemble the pool into a padded batch and hand it over;
+        False when the server closed under the put."""
+        self._drain_claims()
+        if self.pool.size() >= self.pool.capacity:
+            reason = "full"
+        rows, waits = self.pool.seal(self._on_reject)
+        if self._gate is not None:
+            self._gate.disarm(self.hw)  # the pool is empty again
+        if not rows:
+            return True  # every claim expired — nothing rides
+        if reason == "full":
+            self.full_fires += 1
+        elif reason == "ready":
+            self.ready_fires += 1
+            self._gate.clear()
+        else:
+            self.deadline_fires += 1
+        bsize = self._engine.batch_size_for(self.hw, len(rows))
+        with trace.span(
+            "serve_assemble",
+            bucket=f"{self.hw[0]}x{self.hw[1]}",
+            n=len(rows),
+            padded_to=bsize,
+            reason=reason,
+        ):
+            assembled = assemble_requests(rows, self.hw, bsize, waits)
+        self.batches += 1
+        if trace.enabled():
+            trace.counter(
+                f"serve.occupancy.{self.hw[0]}x{self.hw[1]}",
+                round(len(rows) / bsize, 4),
+            )
+        hb.idle()  # a full dispatch queue is device backpressure
+        if not stop_gated_put(self._out, assembled, self._stop):
+            for req in rows:
+                self._on_reject(
+                    req, ServerClosed("server closed mid-batch")
+                )
+            return False
+        hb.beat()
+        return True
+
+    def _claim_timeout(self) -> float:
+        """How long the claim phase may block on the in-queue before the
+        seal conditions are re-checked."""
+        n = self.pool.size()
+        if n == 0:
+            return self._POLL_S
+        fire_at = self.pool.fire_deadline(self._max_delay_s)
+        remaining = max(0.0, (fire_at or 0.0) - monotonic_s())
+        if self._gate is not None:
+            # Continuous: wake fast enough to catch the dispatch gate.
+            return min(self._ARMED_POLL_S, remaining) or self._ARMED_POLL_S
+        # Deadline-only: nothing to notice before the deadline but a
+        # full pool, which the claim itself reports.
+        return min(self._POLL_S, max(remaining, 1e-4))
 
     def _run(self) -> None:
         self._hb = watchdog.register(
             f"serve-batcher-{self.hw[0]}x{self.hw[1]}",
             details=lambda: {
                 "qsize": self._in.qsize(),
+                "claimed": self.pool.size(),
                 "batches": self.batches,
             },
         )
         hb = self._hb
         try:
-            while not self._stop.is_set():
+            while True:
                 hb.beat()
-                batch = self._collect()
-                if not batch:
-                    continue
-                bsize = self._engine.batch_size_for(self.hw, len(batch))
-                with trace.span(
-                    "serve_assemble",
-                    bucket=f"{self.hw[0]}x{self.hw[1]}",
-                    n=len(batch),
-                    padded_to=bsize,
-                ):
-                    assembled = assemble_requests(batch, self.hw, bsize)
-                self.batches += 1
-                hb.idle()  # a full dispatch queue is device backpressure
-                if not stop_gated_put(self._out, assembled, self._stop):
-                    for req in batch:
+                if self._stop.is_set():
+                    return
+                req = self._take_live(self._claim_timeout())
+                if req is not None and not self._claim(req):
+                    # Full pool racing an empty seal (every claim had
+                    # expired): seal made room is the invariant — force
+                    # one now, then the claim cannot fail.
+                    if not self._seal_and_dispatch(hb, "full"):
                         self._on_reject(
                             req, ServerClosed("server closed mid-batch")
                         )
+                        return
+                    self._claim(req)
+                reason = self._seal_reason()
+                if reason is None:
+                    continue
+                if not self._seal_and_dispatch(hb, reason):
                     return
-                hb.beat()
         except BaseException as exc:
             self._on_fatal(exc)
         finally:

@@ -77,6 +77,16 @@ class ServeConfig:
     # its FIRST request reached the batcher (latency floor under light
     # load; under saturation batches fill before the deadline).
     max_delay_ms: float = 10.0
+    # Continuous in-flight batching (ISSUE 14): the batcher is a slot
+    # pool — requests claim slots in the batch being ASSEMBLED up to the
+    # moment it dispatches, and a partial batch seals the instant the
+    # device can take it (the dispatch gate) OR at the deadline,
+    # whichever first, so the device never idles waiting for a "full"
+    # batch.  False = the classic deadline-only coalescing (seal only at
+    # full/deadline), kept alive for comparison benches and as the
+    # conservative fallback; both modes run on the same slot pool and
+    # produce bit-identical detections — only WHEN rows ride changes.
+    continuous: bool = True
     # Bounded queues (admission = the front door; bucket = per-bucket
     # coalescing buffer; dispatch = assembled batches in flight to the
     # device, 2 = classic double buffering).
@@ -176,6 +186,42 @@ class AssembledBatch(NamedTuple):
     scales: np.ndarray  # (B,) float32; 1.0 on pad rows
     valid: np.ndarray  # (B,) bool; False on pad rows
     t_assembled: float
+    # Per live row: ms spent between slot claim and seal (ISSUE 14 —
+    # the serve_slot_wait_ms telemetry source; empty on legacy callers).
+    slot_wait_ms: tuple = ()
+
+
+class OccupancyStats:
+    """Thread-safe bounded window of per-batch device occupancy
+    (live rows / padded batch size — the TResNet full-occupancy signal,
+    ISSUE 14).  ``record()`` is one lock + one append; the mean/last
+    summary is computed lazily at ``snapshot()`` (stats/telemetry path,
+    never the request hot path)."""
+
+    def __init__(self, window: int = 1024):
+        self._lock = threading.Lock()
+        self._window = max(16, window)
+        self._values: list[float] = []
+        self._batches = 0
+
+    def record(self, occupancy: float) -> None:
+        with self._lock:
+            self._batches += 1
+            self._values.append(float(occupancy))
+            if len(self._values) > self._window:
+                del self._values[: -self._window]
+
+    def snapshot(self) -> dict:
+        """{mean, last, batches} over the recent window ({} before the
+        first batch)."""
+        with self._lock:
+            if not self._values:
+                return {}
+            return {
+                "mean": round(sum(self._values) / len(self._values), 4),
+                "last": round(self._values[-1], 4),
+                "batches": self._batches,
+            }
 
 
 class LatencyStats:

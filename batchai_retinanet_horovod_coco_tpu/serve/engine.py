@@ -24,6 +24,15 @@ N is dispatched before batch N−1's results are pulled, so the host-side
 ``evaluate/detect.py`` eval-driver overlap trick, request-path edition).
 When the queue runs dry the pending batch is fetched immediately, so the
 overlap never costs latency under light load.
+
+In continuous mode (ISSUE 14) the one-behind seam grows into a loop
+around a ``DispatchGate``: whenever the device will take the next batch
+immediately — it is idle, or the dispatcher is about to block fetching
+the only in-flight batch — the gate is set, and the bucket batchers seal
+their ASSEMBLING partial batch against it instead of waiting out the
+coalescing deadline.  A batch sealed during batch N's fetch is dispatched
+the instant N's results land, BEFORE N's conversion, so the device hop
+N → N+1 never waits on host-side convert work.
 """
 
 from __future__ import annotations
@@ -225,6 +234,54 @@ class DetectEngine:
         return cls(fns, min_side, max_side, label_to_cat_id, source="live")
 
 
+class DispatchGate:
+    """The device-readiness handshake between the dispatcher and the
+    bucket batchers (continuous mode, ISSUE 14).
+
+    Two signals cross it:
+
+    - **ready** (dispatcher → batchers): the next sealed batch will be
+      dispatched immediately — the device is idle, or batch N's results
+      just landed.  SET by the dispatcher, CLEARED by whoever consumes
+      it (the batcher that seals against it / the dispatcher when a
+      batch arrives).  Batchers seal their assembling partial batch the
+      moment they see it, so N+1 rides the instant N returns instead of
+      padding out the coalescing deadline.
+    - **armed** (batchers → dispatcher): at least one bucket pool has
+      claimed slots.  The dispatcher uses it to decide whether a brief
+      post-fetch handoff wait can yield a batch at all — an idle server
+      never pays the wait on its own completion path.
+    """
+
+    __slots__ = ("_event", "_lock", "_armed")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._armed: set = set()
+
+    def set_ready(self) -> None:
+        self._event.set()
+
+    def clear(self) -> None:
+        self._event.clear()
+
+    def is_ready(self) -> bool:
+        return self._event.is_set()
+
+    def arm(self, key) -> None:
+        with self._lock:
+            self._armed.add(key)
+
+    def disarm(self, key) -> None:
+        with self._lock:
+            self._armed.discard(key)
+
+    def armed(self) -> bool:
+        with self._lock:
+            return bool(self._armed)
+
+
 class DeviceDispatcher:
     """The single device thread: bounded in-queue → one-behind dispatch.
 
@@ -233,6 +290,8 @@ class DeviceDispatcher:
     and future-fulfillment overlap device compute exactly as the eval
     driver's fetch-convert of batch N−1 overlaps batch N's NMS.
     ``on_fatal(exc)`` routes a crash to the frontend (shm error contract).
+    With a ``gate`` (continuous mode) the loop additionally publishes
+    device readiness so partial batches seal against it.
     """
 
     _POLL_S = 0.05
@@ -244,12 +303,14 @@ class DeviceDispatcher:
         on_batch: Callable[[AssembledBatch, object], None],
         on_fatal: Callable[[BaseException], None],
         stop: threading.Event,
+        gate: DispatchGate | None = None,
     ):
         self._engine = engine
         self._queue = batch_queue
         self._on_batch = on_batch
         self._on_fatal = on_fatal
         self._stop = stop
+        self._gate = gate
         self.dispatched_batches = 0
         # watchdog: registers in _run() at thread start.
         self.thread = threading.Thread(
@@ -257,13 +318,62 @@ class DeviceDispatcher:
         )
         self.thread.start()
 
-    def _finish(self, pending) -> None:
+    def _dispatch(self, assembled: AssembledBatch):
+        with trace.span(
+            "serve_dispatch",
+            bucket=f"{assembled.hw[0]}x{assembled.hw[1]}",
+            n=len(assembled.requests),
+        ):
+            det = self._engine.dispatch(assembled.hw, assembled.images)
+        self.dispatched_batches += 1
+        if trace.enabled():
+            trace.counter("serve.dispatch_qsize", self._queue.qsize())
+        return det
+
+    def _fetch(self, pending):
         assembled, det = pending
         with trace.span(
             "serve_fetch", bucket=f"{assembled.hw[0]}x{assembled.hw[1]}"
         ):
-            fetched = self._engine.fetch(det)
-        self._on_batch(assembled, fetched)
+            return self._engine.fetch(det)
+
+    def _finish(self, pending) -> None:
+        self._on_batch(pending[0], self._fetch(pending))
+
+    # Post-fetch handoff: how long the dispatcher gives an ARMED batcher
+    # to seal against the just-raised gate before converting anyway.
+    # Covers the batcher's armed poll (~2 ms) with margin; only ever
+    # paid when slots are actually claimed.
+    _HANDOFF_S = 0.02
+
+    def _idle_flush(self, pending):
+        """Queue ran dry with one batch in flight: fetch it now (overlap
+        never costs latency under light load).  Continuous mode raises
+        the gate the moment the results land — the assembling batch
+        (claiming slots this whole round) seals against it and is
+        dispatched BEFORE the fetched batch's conversion, so the device
+        hop N → N+1 never waits on host-side convert work.  Returns the
+        new pending batch (or None)."""
+        if self._gate is None:
+            self._finish(pending)
+            return None
+        fetched = self._fetch(pending)
+        self._gate.set_ready()
+        nxt = None
+        try:
+            if self._gate.armed():
+                # Claimed slots exist: give their batcher one beat to
+                # seal N+1 so it rides now, not a poll later.
+                nxt = self._queue.get(timeout=self._HANDOFF_S)
+            else:
+                nxt = self._queue.get_nowait()
+        except queue.Empty:
+            pass  # still idle: the gate stays set
+        if nxt is not None:
+            self._gate.clear()
+            det = self._dispatch(nxt)
+        self._on_batch(pending[0], fetched)
+        return (nxt, det) if nxt is not None else None
 
     def _run(self) -> None:
         # Beats on every poll (an idle dispatcher is healthy); a wedged
@@ -282,24 +392,25 @@ class DeviceDispatcher:
                 hb.beat()
                 if self._stop.is_set():
                     return
+                if self._gate is not None and pending is None:
+                    self._gate.set_ready()  # fully idle device
                 try:
-                    assembled = self._queue.get(timeout=self._POLL_S)
+                    if self._gate is not None and pending is not None:
+                        # Continuous: never park a finished device round
+                        # behind the poll — no queued batch means go
+                        # straight to the fetch (which blocks on device
+                        # compute; the gate lets the next batch seal
+                        # DURING it and ride at fetch-return).
+                        assembled = self._queue.get_nowait()
+                    else:
+                        assembled = self._queue.get(timeout=self._POLL_S)
                 except queue.Empty:
-                    # Idle: flush the one-behind batch now so overlap
-                    # never costs latency when no next batch exists.
                     if pending is not None:
-                        self._finish(pending)
-                        pending = None
+                        pending = self._idle_flush(pending)
                     continue
-                with trace.span(
-                    "serve_dispatch",
-                    bucket=f"{assembled.hw[0]}x{assembled.hw[1]}",
-                    n=len(assembled.requests),
-                ):
-                    det = self._engine.dispatch(assembled.hw, assembled.images)
-                self.dispatched_batches += 1
-                if trace.enabled():
-                    trace.counter("serve.dispatch_qsize", self._queue.qsize())
+                if self._gate is not None:
+                    self._gate.clear()
+                det = self._dispatch(assembled)
                 if pending is not None:
                     self._finish(pending)
                 pending = (assembled, det)
@@ -316,6 +427,7 @@ class DeviceDispatcher:
 __all__ = [
     "DetectEngine",
     "DeviceDispatcher",
+    "DispatchGate",
     "IdentityLabelMap",
     "stop_gated_put",
 ]

@@ -87,9 +87,18 @@ def replica_weight(load: dict | None, p99_ref: float | None = None) -> float:
 
     ``headroom / (1 + inflight/capacity)``, scaled down by
     ``p99_ref / p99`` when this replica's windowed p99 is worse than the
-    fleet's best (``p99_ref``).  0 means unroutable: not accepting, or
-    no admission headroom left (the edge sheds instead of queueing).
-    Pure — pinned exactly by tests/unit/test_fleet.py.
+    fleet's best (``p99_ref``), and by the slot-occupancy factor
+    ``(1 + free_slots/slot_capacity) / 2`` when the replica advertises
+    its assembling-batch slots (ISSUE 14): a replica whose device slots
+    are fully claimed takes half the traffic of one with an idle pool,
+    so the fleet steers load AT idle device capacity before queues ever
+    grow.  Replicas that don't advertise slots (older builds) get the
+    neutral factor 1 — the deterministic tie-break is the formula
+    itself: identical load fields always produce identical weights, and
+    the router's candidate order is fixed by replica_id.  0 means
+    unroutable: not accepting, or no admission headroom left (the edge
+    sheds instead of queueing).  Pure — pinned exactly by
+    tests/unit/test_fleet.py.
     """
     if not load or not load.get("accepting", False):
         return 0.0
@@ -98,6 +107,12 @@ def replica_weight(load: dict | None, p99_ref: float | None = None) -> float:
     headroom = max(0.0, 1.0 - qsize / cap)
     inflight = max(0, int(load.get("inflight") or 0))
     w = headroom / (1.0 + inflight / cap)
+    slot_cap = load.get("slot_capacity")
+    if slot_cap and int(slot_cap) > 0:
+        free = min(
+            max(0, int(load.get("free_slots") or 0)), int(slot_cap)
+        )
+        w *= (1.0 + free / int(slot_cap)) / 2.0
     p99 = load.get("p99_ms")
     if p99 and p99_ref and float(p99) > 0 and float(p99_ref) > 0:
         w *= min(1.0, float(p99_ref) / float(p99))
@@ -341,6 +356,11 @@ class FleetRouter:
             ]
             if not candidates:
                 return None
+            # Deterministic tie-break (ISSUE 14): the weighted draw walks
+            # candidates in replica_id order, never registration/arrival
+            # order, so equal weights resolve identically across runs
+            # given the seeded RNG.
+            candidates.sort(key=lambda st: str(st.replica.replica_id))
             total = sum(st.weight for st in candidates)
             x = self._rng.random() * total
             for st in candidates:

@@ -66,6 +66,7 @@ from batchai_retinanet_horovod_coco_tpu.serve.common import (
     AssembledBatch,
     DetectionFuture,
     LatencyStats,
+    OccupancyStats,
     RequestRejected,
     RequestTimeout,
     ServeConfig,
@@ -77,6 +78,7 @@ from batchai_retinanet_horovod_coco_tpu.serve.common import (
 from batchai_retinanet_horovod_coco_tpu.serve.engine import (
     DetectEngine,
     DeviceDispatcher,
+    DispatchGate,
 )
 from batchai_retinanet_horovod_coco_tpu.serve.router import Router
 
@@ -116,6 +118,18 @@ class DetectionServer:
             "request latency over the recent window (accepted requests)",
             source=self.stats.window_ms,
         )
+        # Slot-wait distribution (ISSUE 14): fed per dispatched batch in
+        # _on_batch, exposed pull-only on THIS registry so both /metrics
+        # surfaces carry it with no enable gating (the process-registry
+        # twin, telemetry.record_serve_batch, is push-gated like the
+        # train sites).
+        self._slot_waits: list[float] = []
+        self.telemetry.histogram(
+            "serve_slot_wait_ms",
+            "ms a claimed slot waited between claim and seal (continuous "
+            "in-flight batching admission latency)",
+            source=self._slot_wait_window,
+        )
         self.telemetry.register_collector(self._telemetry_samples)
         self.telemetry.register_collector(telemetry.watchdog_collector())
         if warmup:
@@ -130,6 +144,7 @@ class DetectionServer:
         self._closed = False
         self._ids = itertools.count()
         self._batches_done = 0
+        self.occupancy = OccupancyStats()
 
         self._admission: queue.Queue = queue.Queue(
             maxsize=max(1, config.admission_queue)
@@ -141,6 +156,9 @@ class DetectionServer:
         self._dispatch_queue: queue.Queue = queue.Queue(
             maxsize=max(1, config.dispatch_depth)
         )
+        # Continuous in-flight batching (ISSUE 14): the gate is the
+        # device-readiness handshake partial batches seal against.
+        self._gate = DispatchGate() if config.continuous else None
         self._router = Router(
             engine,
             self._admission,
@@ -160,6 +178,7 @@ class DetectionServer:
                 on_reject=self._reject,
                 on_fatal=self._fail,
                 stop=self._stop,
+                gate=self._gate,
             )
             for hw in engine.buckets
         ]
@@ -169,6 +188,7 @@ class DetectionServer:
             on_batch=self._on_batch,
             on_fatal=self._fail,
             stop=self._stop,
+            gate=self._gate,
         )
 
     # ---- client surface --------------------------------------------------
@@ -227,7 +247,28 @@ class DetectionServer:
         snap["dispatch_qsize"] = self._dispatch_queue.qsize()
         snap["batches"] = self._batches_done
         snap["deadline_fires"] = sum(b.deadline_fires for b in self._batchers)
+        snap["full_fires"] = sum(b.full_fires for b in self._batchers)
+        snap["ready_fires"] = sum(b.ready_fires for b in self._batchers)
+        snap["slot_evictions"] = sum(b.pool.evictions for b in self._batchers)
+        snap["free_slots"] = self.free_slots()
+        snap["slot_capacity"] = self.slot_capacity()
+        occ = self.occupancy.snapshot()
+        snap["occupancy_mean"] = occ.get("mean")
+        snap["occupancy_last"] = occ.get("last")
+        snap["continuous"] = self.config.continuous
         return snap
+
+    def free_slots(self) -> int:
+        """Unclaimed slots across every bucket's ASSEMBLING batch — the
+        idle-capacity signal the fleet router steers on (ISSUE 14)."""
+        return sum(b.pool.free_slots() for b in self._batchers)
+
+    def _slot_wait_window(self) -> list[float]:
+        with self._lock:
+            return list(self._slot_waits)
+
+    def slot_capacity(self) -> int:
+        return sum(b.pool.capacity for b in self._batchers)
 
     def _telemetry_samples(self):
         """Scrape-time collector: the snapshot() fields as Prometheus
@@ -249,6 +290,23 @@ class DetectionServer:
         yield ("serve_deadline_fires_total", "counter",
                "partial batches fired by the coalescing deadline", None,
                snap["deadline_fires"])
+        yield ("serve_ready_fires_total", "counter",
+               "partial batches sealed by the dispatch gate (continuous "
+               "in-flight batching)", None, snap["ready_fires"])
+        yield ("serve_slot_evictions_total", "counter",
+               "claimed slots freed by expired-deadline eviction at the "
+               "dispatch window", None, snap["slot_evictions"])
+        yield ("serve_free_slots", "gauge",
+               "unclaimed slots across the assembling batches (idle "
+               "device capacity the fleet router steers on)", None,
+               snap["free_slots"])
+        if snap["occupancy_mean"] is not None:
+            yield ("serve_batch_occupancy_mean", "gauge",
+                   "mean live-rows/batch-size over the recent batch "
+                   "window", None, snap["occupancy_mean"])
+            yield ("serve_batch_occupancy_last", "gauge",
+                   "live-rows/batch-size of the last dispatched batch",
+                   None, snap["occupancy_last"])
         yield ("serve_inflight", "gauge",
                "requests accepted and not yet resolved", None,
                snap["outstanding"])
@@ -285,6 +343,13 @@ class DetectionServer:
             "p99_ms": snap.get("p99_ms"),
             "completed": snap["completed"],
             "shed_total": snap["shed_total"],
+            # Occupancy signals (ISSUE 14): free slots in the assembling
+            # batches + recent mean batch occupancy — the fleet router
+            # folds these into its weights so load steers at replicas
+            # with idle device capacity.
+            "free_slots": snap["free_slots"],
+            "slot_capacity": snap["slot_capacity"],
+            "occupancy": snap["occupancy_mean"],
             "accepting": self._accepting,
         }
 
@@ -379,38 +444,56 @@ class DetectionServer:
     def _on_batch(self, assembled: AssembledBatch, det) -> None:
         reqs = assembled.requests
         n = assembled.images.shape[0]
-        ids = np.full((n,), -1, dtype=np.int64)
-        ids[: len(reqs)] = [r.id for r in reqs]
-        image_sizes = {r.id: r.orig_wh for r in reqs}
         with trace.span(
             "serve_convert",
             bucket=f"{assembled.hw[0]}x{assembled.hw[1]}",
             n=len(reqs),
         ):
-            # THE eval-path conversion (rescale to original coords, clamp
-            # to true bounds, drop degenerates) — shared, not cloned.
-            results = detections_to_coco(
-                det,
-                ids,
-                assembled.scales,
-                assembled.valid,
-                self.engine.label_to_cat_id,
-                image_sizes=image_sizes,
-            )
-        by_id: dict[int, list[dict]] = {r.id: [] for r in reqs}
-        for r in results:
-            by_id[r["image_id"]].append(r)
-        for req in reqs:
-            dets = by_id[req.id]
-            for d in dets:
-                d.pop("image_id", None)  # request-scoped; id is transport
-            if req.expired():
-                self._finish(req, error=RequestTimeout(
-                    f"request {req.id} finished after its deadline"
-                ))
-            else:
-                self._finish(req, result=dets)
+            # Per-row completion release (ISSUE 14): de-pad, convert, and
+            # resolve ROW BY ROW — an early row's future resolves without
+            # waiting on its bucket siblings' conversion.  The conversion
+            # IS the eval path's ``detections_to_coco`` (rescale to
+            # original coords, clamp to true bounds, drop degenerates),
+            # called on single-row views; it is strictly per-row math, so
+            # the slicing cannot change any result (PARITY §5.9).  Pad
+            # rows (beyond len(reqs)) never convert at all.
+            for i, req in enumerate(reqs):
+                row = type(det)(
+                    det.boxes[i:i + 1], det.scores[i:i + 1],
+                    det.labels[i:i + 1], det.valid[i:i + 1],
+                )
+                dets = detections_to_coco(
+                    row,
+                    np.array([req.id], dtype=np.int64),
+                    assembled.scales[i:i + 1],
+                    assembled.valid[i:i + 1],
+                    self.engine.label_to_cat_id,
+                    image_sizes={req.id: req.orig_wh},
+                )
+                for d in dets:
+                    d.pop("image_id", None)  # request-scoped; transport
+                if req.expired():
+                    self._finish(req, error=RequestTimeout(
+                        f"request {req.id} finished after its deadline"
+                    ))
+                else:
+                    self._finish(req, result=dets)
         self._batches_done += 1
+        self.occupancy.record(len(reqs) / max(1, n))
+        if assembled.slot_wait_ms:
+            with self._lock:
+                self._slot_waits.extend(assembled.slot_wait_ms)
+                if len(self._slot_waits) > 4096:
+                    del self._slot_waits[:-4096]
+        if telemetry.enabled():
+            # Args computed only on the enabled path: free_slots() takes
+            # one lock per bucket pool — not a price the disabled hot
+            # path pays (the callee's own gate is the second check).
+            telemetry.record_serve_batch(
+                occupancy=len(reqs) / max(1, n),
+                free_slots=self.free_slots(),
+                slot_wait_ms=assembled.slot_wait_ms,
+            )
         if (
             self.sink is not None
             and self._batches_done % max(1, self.config.stats_every_batches)
@@ -631,6 +714,15 @@ def main(argv: list[str] | None = None) -> dict:
         # close the sink on the way out.  Same policy as train.py's
         # _start_telemetry: either flag starts the monitor (the built-in
         # stall rule is always included).
+        if (
+            obs_dir is not None
+            or getattr(args, "slo_rule", None)
+            or getattr(args, "obs_port", None) is not None
+        ):
+            # Arm the push-path record sites (telemetry.record_serve_batch
+            # → the process default registry) whenever observability is
+            # on — the same policy as train.py's _start_telemetry.
+            telemetry.enable()
         if (
             getattr(args, "slo_rule", None)
             or getattr(args, "obs_port", None) is not None

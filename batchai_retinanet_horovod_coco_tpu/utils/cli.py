@@ -272,7 +272,16 @@ def add_serve_flags(parser) -> None:
     parser.add_argument("--serve-max-delay-ms", type=float, default=10.0,
                         help="dynamic-batching deadline: a partial batch "
                              "fires at most this long after its first "
-                             "request reaches the batcher")
+                             "request reaches the batcher (in continuous "
+                             "mode the deadline is the upper bound; the "
+                             "dispatch gate usually seals first)")
+    parser.add_argument("--serve-batching", default="continuous",
+                        choices=["continuous", "deadline"],
+                        help="continuous (default): slot-pool in-flight "
+                             "batching — batch N+1 assembles while N runs "
+                             "and seals the instant the device is ready; "
+                             "deadline: the classic deadline-only "
+                             "coalescing (comparison/benchmark mode)")
     parser.add_argument("--serve-admission-queue", type=int, default=128,
                         help="bounded front-door queue; a full queue "
                              "REJECTS (sheds) instead of growing — "
@@ -307,6 +316,8 @@ def make_serve_config(args):
 
     return ServeConfig(
         max_delay_ms=args.serve_max_delay_ms,
+        continuous=getattr(args, "serve_batching", "continuous")
+        == "continuous",
         admission_queue=args.serve_admission_queue,
         bucket_queue=args.serve_bucket_queue,
         preprocess_workers=args.serve_workers,

@@ -1363,6 +1363,396 @@ def _serve_overload(engine, hw, batch_size, img) -> dict:
     }
 
 
+def _mixed_arrival_schedule(
+    n: int, base_rate: float, seed: int = 0
+) -> list[float]:
+    """Seeded open-loop MIXED arrival times (absolute seconds): cycling
+    steady → burst → lull phases of exponential inter-arrivals — the
+    load shape that exposes deadline-only partial-batch waste (ISSUE
+    14).  Same seed ⇒ same offered load, so the continuous and
+    deadline legs race the identical schedule."""
+    rng = np.random.default_rng(seed)
+    phases = (1.0, 1.8, 0.7)
+    phase_len = max(1, n // 6)
+    t, times = 0.0, []
+    for i in range(n):
+        rate = base_rate * phases[(i // phase_len) % len(phases)]
+        t += float(rng.exponential(1.0 / rate))
+        times.append(t)
+    return times
+
+
+def _open_loop_leg(server, images: list, schedule: list[float]) -> dict:
+    """Drive one server with the seeded open-loop schedule (request i =
+    images[i % len] submitted at schedule[i]); returns p50/p99 over
+    completed requests + the server's occupancy/fire counters, and the
+    per-request results for the bit-identity cross-check."""
+    from batchai_retinanet_horovod_coco_tpu.obs.events import (
+        latency_percentiles,
+    )
+    from batchai_retinanet_horovod_coco_tpu.serve import RequestRejected
+
+    import threading
+
+    t0 = time.perf_counter()
+    pending: list[tuple[int, float, object]] = []
+    lock = threading.Lock()
+    submitted = threading.Event()
+    shed = [0]
+
+    errors: list[str] = []
+
+    def submit_on_schedule():
+        try:
+            for i, due in enumerate(schedule):
+                delay = t0 + due - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                try:
+                    fut = server.submit(images[i % len(images)])
+                except RequestRejected:
+                    with lock:
+                        shed[0] += 1
+                    continue
+                with lock:
+                    pending.append((i, time.perf_counter(), fut))
+        except BaseException as e:
+            # Crash channel (thread-error-contract): a dead submitter
+            # skews the open-loop comparison — record and re-raise as a
+            # bench failure after the join.
+            with lock:
+                errors.append(repr(e))
+            raise
+        finally:
+            submitted.set()
+
+    # watchdog-exempt: bench load generator, joined below.
+    sub = threading.Thread(
+        target=submit_on_schedule, daemon=True, name="bench-open-loop"
+    )
+    sub.start()
+    # Collect CONCURRENTLY with submission, in submission order (batch
+    # completion is FIFO here), so each latency is measured at the
+    # moment its future resolves — not at drain time.
+    latencies, results = [], {}
+    j = 0
+    while True:
+        with lock:
+            item = pending[j] if j < len(pending) else None
+        if item is None:
+            if submitted.is_set() and j >= len(pending):
+                break
+            time.sleep(0.002)
+            continue
+        i, t_sub, fut = item
+        j += 1
+        try:
+            results[i] = fut.result(timeout=600)
+        except Exception:
+            with lock:
+                shed[0] += 1
+            continue
+        latencies.append((time.perf_counter() - t_sub) * 1e3)
+    sub.join(timeout=60)
+    if errors:
+        raise RuntimeError(f"open-loop submitter crashed: {errors}")
+    shed = shed[0]
+    snap = server.snapshot()
+    pct = latency_percentiles(latencies, ps=(50, 99)) if latencies else {}
+    return {
+        "requests": len(schedule),
+        "completed": len(latencies),
+        "shed": shed,
+        "p50_ms": pct.get("p50_ms"),
+        "p99_ms": pct.get("p99_ms"),
+        "occupancy_mean": snap.get("occupancy_mean"),
+        "batches": snap.get("batches"),
+        "deadline_fires": snap.get("deadline_fires"),
+        "ready_fires": snap.get("ready_fires"),
+        "full_fires": snap.get("full_fires"),
+        "_results": results,
+    }
+
+
+def run_continuous_leg(
+    make_engine,
+    img_for,
+    base_rate: float,
+    n_requests: int,
+    engine_kind: str,
+    bit_check=None,
+    seed: int = 0,
+) -> dict:
+    """The continuous-vs-deadline comparison (ISSUE 14): the SAME seeded
+    open-loop mixed-arrival schedule against the SAME executable, once
+    with the slot-pool dispatch gate (``continuous=True``) and once
+    deadline-only.  The contract the committed fields pin: continuous
+    mean device batch occupancy strictly above deadline-only, p99 no
+    worse (band), and — on the live-engine leg — served detections
+    bit-identical to the sequential path on the same artifacts.
+
+    ``make_engine()`` returns the (shared) engine per leg; ``img_for(i)``
+    the i-th distinct request payload; ``bit_check(results, images)``
+    the in-run sequential cross-check (live engine only).
+    """
+    from batchai_retinanet_horovod_coco_tpu.serve import (
+        DetectionServer,
+        ServeConfig,
+    )
+
+    n_imgs = 4
+    images = [img_for(i) for i in range(n_imgs)]
+    schedule = _mixed_arrival_schedule(n_requests, base_rate, seed)
+    legs = {}
+    for mode, continuous in (("deadline", False), ("continuous", True)):
+        engine = make_engine()
+        server = DetectionServer(
+            engine,
+            ServeConfig(
+                max_delay_ms=10.0,
+                continuous=continuous,
+                preprocess_workers=2,
+            ),
+            warmup=False,
+        )
+        try:
+            with obs_trace.span("serve_continuous_leg", mode=mode):
+                legs[mode] = _open_loop_leg(server, images, schedule)
+        finally:
+            server.close(drain=False)
+    out = {
+        "engine": engine_kind,
+        "requests": n_requests,
+        "seed": seed,
+        "base_rate_per_s": round(base_rate, 3),
+        "deadline": {
+            k: v for k, v in legs["deadline"].items() if k != "_results"
+        },
+        "continuous": {
+            k: v for k, v in legs["continuous"].items() if k != "_results"
+        },
+    }
+    d_occ = legs["deadline"]["occupancy_mean"] or 0.0
+    c_occ = legs["continuous"]["occupancy_mean"] or 0.0
+    out["occupancy_gain"] = round(c_occ - d_occ, 4)
+    d99, c99 = legs["deadline"]["p99_ms"], legs["continuous"]["p99_ms"]
+    if d99 and c99:
+        out["p99_ratio"] = round(c99 / d99, 4)
+    if bit_check is not None:
+        out["bit_identical"] = bit_check(
+            legs["continuous"]["_results"], images
+        )
+    return out
+
+
+def run_continuous_leg_stub(seed: int = 0) -> dict:
+    """The device-independent fast path (``SERVEBENCH_E2E=0`` — the
+    servebench-check tripwire): the stub engine with injected device
+    time, so the occupancy/p99 contract is checked on every box."""
+    from batchai_retinanet_horovod_coco_tpu.serve.stub import (
+        StubDetectEngine,
+    )
+
+    delay_s, batch = 0.03, 8
+    capacity = batch / delay_s
+
+    def img_for(i):
+        rng = np.random.default_rng(100 + i)
+        return rng.integers(0, 256, (64, 64, 3), dtype=np.uint8)
+
+    return run_continuous_leg(
+        make_engine=lambda: StubDetectEngine(
+            batch_sizes=(batch,), delay_s=delay_s
+        ),
+        img_for=img_for,
+        base_rate=0.9 * capacity,
+        n_requests=int(os.environ.get("SERVEBENCH_CONTINUOUS_N", "240")),
+        engine_kind="stub",
+        seed=seed,
+    )
+
+
+def run_continuous_leg_e2e(model, state, batch_size: int, seed: int = 0) -> dict:
+    """The live-executable leg (the committed capture): flagship bucket,
+    arrival rate derived from the in-run detect ceiling, plus the in-run
+    bit-identity cross-check — each continuous-mode result compared
+    against the SAME artifact driven sequentially (single-request
+    assembly through ``assemble_requests`` + ``detections_to_coco``,
+    exactly the serve conversion)."""
+    import jax as _jax
+
+    from batchai_retinanet_horovod_coco_tpu.evaluate.detect import (
+        DetectConfig,
+        detections_to_coco,
+    )
+    from batchai_retinanet_horovod_coco_tpu.data.pipeline import (
+        bucket_for_source,
+        resize_for_bucket,
+    )
+    from batchai_retinanet_horovod_coco_tpu.serve import DetectEngine
+    from batchai_retinanet_horovod_coco_tpu.serve.batcher import (
+        assemble_requests,
+    )
+    from batchai_retinanet_horovod_coco_tpu.serve.common import ServeRequest
+
+    hw = BUCKET
+    min_side, max_side = 800, 1333
+    # Sub-prior threshold so the untrained head yields detections and
+    # the bit-identity check cannot pass vacuously (the test-suite
+    # policy, tests/unit/test_serve.py::_detect_config).
+    config = DetectConfig(
+        score_threshold=0.001, pre_nms_size=64, max_detections=10
+    )
+    engine = DetectEngine.from_state(
+        model, state, buckets=(hw,), batch_sizes=(batch_size,),
+        config=config, min_side=min_side, max_side=max_side,
+    )
+    engine.warmup()
+    ceiling = _serve_ceiling(engine, hw, batch_size, 2)
+
+    def img_for(i):
+        h, w = hw
+        shape = (
+            (min_side, max_side) if h < w
+            else (max_side, min_side) if h > w
+            else (min_side, min_side)
+        )
+        rng = np.random.default_rng(100 + i)
+        return rng.integers(0, 256, (*shape, 3), dtype=np.uint8)
+
+    def bit_check(results: dict, images: list) -> bool:
+        if not results:
+            # Anti-vacuity (the sub-prior-threshold policy's sibling): a
+            # leg that completed nothing verified nothing.
+            print("# continuous-leg bit-identity VACUOUS: no completed "
+                  "requests to compare", flush=True)
+            return False
+        ok = True
+        for idx in sorted(set(i % len(images) for i in results)):
+            img = images[idx]
+            h, w = img.shape[:2]
+            bucket = bucket_for_source(
+                h, w, min_side, max_side, engine.buckets
+            )
+            resized, scale = resize_for_bucket(
+                img, bucket, min_side, max_side
+            )
+            req = ServeRequest(0, None, None)
+            req.image, req.scale = resized, np.float32(scale)
+            req.orig_wh = (w, h)
+            assembled = assemble_requests([req], bucket, batch_size)
+            det = _jax.device_get(
+                engine.dispatch(bucket, assembled.images)
+            )
+            want = detections_to_coco(
+                det, np.array([0], np.int64), assembled.scales,
+                assembled.valid, engine.label_to_cat_id,
+                image_sizes={0: (w, h)},
+            )
+            for d in want:
+                d.pop("image_id", None)
+            got = [results[i] for i in results if i % len(images) == idx]
+            if any(g != want for g in got):
+                ok = False
+                print(
+                    f"# continuous-leg bit-identity MISMATCH on image "
+                    f"{idx}", flush=True,
+                )
+        return ok
+
+    n = int(os.environ.get(
+        "SERVEBENCH_E2E_N", str(max(12, 3 * batch_size))
+    ))
+    return run_continuous_leg(
+        make_engine=lambda: engine,
+        img_for=img_for,
+        base_rate=0.85 * ceiling,
+        n_requests=n,
+        engine_kind="live",
+        bit_check=bit_check,
+        seed=seed,
+    )
+
+
+def check_continuous_against_committed(fresh: dict | None) -> int:
+    """The continuous-batching half of servebench-check (ISSUE 14).
+    Relative contracts are device-independent and enforced everywhere:
+    continuous occupancy STRICTLY above deadline-only on the same
+    schedule, p99 no worse than the band, bit-identity true when the
+    live leg ran.  The absolute occupancy floor vs the committed record
+    applies when the fresh leg ran the same engine kind (the
+    device-class guard's sibling)."""
+    try:
+        with open(_artifact_path("SERVEBENCH.json")) as f:
+            committed = json.load(f).get("continuous")
+    except (OSError, ValueError) as e:
+        print(f"# servebench-check[continuous]: cannot read baseline: {e}")
+        return 1
+    if fresh is None:
+        print("# servebench-check[continuous]: leg disabled "
+              "(SERVEBENCH_CONTINUOUS=0) — the committed record goes "
+              "UNCHECKED this run")
+        return 0
+    rc = 0
+    c_occ = fresh["continuous"]["occupancy_mean"] or 0.0
+    d_occ = fresh["deadline"]["occupancy_mean"] or 0.0
+    if not c_occ > d_occ:
+        print(
+            f"# servebench-check[continuous]: occupancy {c_occ} not "
+            f"strictly above deadline-only {d_occ} on the same seeded "
+            "schedule: REGRESSION"
+        )
+        rc = 1
+    band = float(os.environ.get("SERVEBENCH_P99_BAND", "1.25"))
+    ratio = fresh.get("p99_ratio")
+    if ratio is not None and ratio > band:
+        print(
+            f"# servebench-check[continuous]: p99 ratio {ratio} above "
+            f"the no-worse band {band}: REGRESSION"
+        )
+        rc = 1
+    e2e = fresh.get("e2e") or {}
+    if e2e.get("bit_identical") is False:
+        print("# servebench-check[continuous]: continuous-mode served "
+              "detections diverged from the sequential path: REGRESSION")
+        rc = 1
+    if committed is None:
+        print("# servebench-check[continuous]: committed SERVEBENCH.json "
+              "has no continuous record yet — re-capture with "
+              "`make servebench`")
+        return rc
+    if committed.get("engine") == fresh.get("engine"):
+        floor = 0.9 * float(
+            committed["continuous"].get("occupancy_mean") or 0.0
+        )
+        if c_occ < floor:
+            print(
+                f"# servebench-check[continuous]: occupancy {c_occ} "
+                f"under the committed floor {round(floor, 4)}: REGRESSION"
+            )
+            rc = 1
+    else:
+        print(
+            "# servebench-check[continuous]: committed leg ran "
+            f"engine={committed.get('engine')}, fresh ran "
+            f"{fresh.get('engine')} — absolute floor skipped (relative "
+            "contracts enforced above)"
+        )
+    if committed.get("e2e") and not e2e:
+        print(
+            "# servebench-check[continuous]: committed live-executable "
+            "leg goes UNCHECKED on the SERVEBENCH_E2E=0 fast path — "
+            "re-capture with `make servebench` for the full oracle"
+        )
+    if rc == 0:
+        print(
+            f"# servebench-check[continuous]: occupancy {c_occ} > "
+            f"deadline {d_occ}, p99 ratio {ratio}, "
+            f"bit_identical={e2e.get('bit_identical', 'n/a')}: ok"
+        )
+    return rc
+
+
 def _scrape_telemetry(server) -> dict:
     """Scrape the live-telemetry plane ONCE per measurement window
     (ISSUE 9 satellite): mount the real HTTP frontend over the just-
@@ -1704,11 +2094,13 @@ def check_fleet_against_committed(fresh: dict | None) -> int:
 
 
 def check_serve_against_committed(
-    value: float, device_kind: str, fleet: dict | None = None
+    value: float, device_kind: str, fleet: dict | None = None,
+    continuous: dict | None = None,
 ) -> int:
     """servebench-check: fresh flagship closed-loop SERVE rate vs the
     committed SERVEBENCH.json — same floor/device policy as bench-check
-    (``_check_floor``) — plus the fleet availability band (ISSUE 12)."""
+    (``_check_floor``) — plus the fleet availability band (ISSUE 12) and
+    the continuous-batching occupancy/p99 contract (ISSUE 14)."""
     try:
         with open(_artifact_path("SERVEBENCH.json")) as f:
             committed = json.load(f)
@@ -1723,7 +2115,11 @@ def check_serve_against_committed(
         str(committed.get("device_kind", "")) or None,
         device_kind,
     )
-    return max(rc, check_fleet_against_committed(fleet))
+    return max(
+        rc,
+        check_fleet_against_committed(fleet),
+        check_continuous_against_committed(continuous),
+    )
 
 
 def run_serve_mode() -> None:
@@ -1770,6 +2166,26 @@ def run_serve_mode() -> None:
     if os.environ.get("SERVEBENCH_FLEET", "1") not in ("", "0"):
         fleet = run_fleet_leg()
         out["fleet"] = fleet
+    # Continuous-vs-deadline leg (ISSUE 14): the same seeded open-loop
+    # mixed-arrival schedule against the same executable in both
+    # batching modes.  SERVEBENCH_E2E=1 (capture default) runs it on the
+    # live flagship executable with the in-run bit-identity cross-check;
+    # SERVEBENCH_E2E=0 (the check target's fast path) runs the
+    # device-independent stub leg.  SERVEBENCH_CONTINUOUS=0 skips.
+    cont = None
+    if os.environ.get("SERVEBENCH_CONTINUOUS", "1") not in ("", "0"):
+        with obs_trace.span("serve_continuous_vs_deadline"):
+            # The stub comparison ALWAYS runs (device-independent — the
+            # occupancy/p99 contract is checkable on every box); the
+            # live-executable leg with the in-run bit-identity
+            # cross-check rides along unless SERVEBENCH_E2E=0 (the
+            # check target's fast path).
+            cont = run_continuous_leg_stub()
+            if os.environ.get("SERVEBENCH_E2E", "1") not in ("", "0"):
+                cont["e2e"] = run_continuous_leg_e2e(
+                    model, state, batch_size
+                )
+        out["continuous"] = cont
     att = _trace_attribution()
     if att is not None:
         out["attribution"] = att
@@ -1777,7 +2193,7 @@ def run_serve_mode() -> None:
 
     if os.environ.get("BENCH_CHECK", "") not in ("", "0"):
         raise SystemExit(
-            check_serve_against_committed(value, device_kind, fleet)
+            check_serve_against_committed(value, device_kind, fleet, cont)
         )
 
 

@@ -783,6 +783,20 @@ def _serve_kill_leg() -> None:
                 fam.encode() in metrics_body,
                 f"kill leg: {fam} missing from fleet /metrics",
             )
+        # ISSUE 14: the leg runs under CONTINUOUS in-flight batching (the
+        # serve default) — the replicas must advertise the slot-pool load
+        # fields the router's weight formula consumes.
+        loads = [
+            r.get("load", {})
+            for r in _fleet_status(fleet.base_url).get("replicas", [])
+        ]
+        check(
+            any(
+                "free_slots" in ld and "slot_capacity" in ld for ld in loads
+            ),
+            "kill leg: no replica advertises the continuous slot-pool "
+            f"load fields (free_slots/slot_capacity): {loads}",
+        )
     finally:
         fleet.stop()
 
@@ -857,7 +871,10 @@ def _serve_canary_leg() -> None:
 
 
 def run_serve_legs() -> None:
-    """The fleet serve schedule (``make fleet-smoke`` / ``--serve``)."""
+    """The fleet serve schedule (``make fleet-smoke`` / ``--serve``).
+    Since ISSUE 14 the replicas run CONTINUOUS in-flight batching (the
+    serve default; the kill leg pins the advertised slot-pool fields),
+    so the chaos contracts are proven against the slot-pool path."""
     _serve_kill_leg()
     _serve_canary_leg()
 

@@ -332,3 +332,51 @@ class TestServeBenchCheck:
         assert overload["shed_at_submit"] > 0
         assert overload["resolved"] == overload["accepted"]
         assert overload["sheds_instead_of_queueing"] is True
+
+    def test_committed_continuous_record_holds_the_contract(self):
+        """ISSUE 14: the committed continuous-vs-deadline leg must show
+        occupancy strictly above deadline-only under the same seeded
+        schedule, p99 no worse than the band, and — when the live leg
+        was captured — bit-identity true."""
+        cont = self._committed().get("continuous")
+        assert cont, "SERVEBENCH.json has no continuous record"
+        assert cont["engine"] == "stub"  # device-independent comparison
+        assert (
+            cont["continuous"]["occupancy_mean"]
+            > cont["deadline"]["occupancy_mean"]
+        )
+        assert cont["p99_ratio"] <= 1.25
+        if cont.get("e2e"):
+            assert cont["e2e"]["bit_identical"] is True
+
+    def test_continuous_check_bites_on_occupancy_regression(self, capsys):
+        fresh = {
+            "engine": "stub",
+            "deadline": {"occupancy_mean": 0.8, "p99_ms": 100.0},
+            "continuous": {"occupancy_mean": 0.7, "p99_ms": 100.0},
+            "p99_ratio": 1.0,
+        }
+        assert bench.check_continuous_against_committed(fresh) == 1
+        assert "REGRESSION" in capsys.readouterr().out
+
+    def test_continuous_check_bites_on_p99_band(self, capsys):
+        fresh = {
+            "engine": "stub",
+            "deadline": {"occupancy_mean": 0.6, "p99_ms": 100.0},
+            "continuous": {"occupancy_mean": 0.8, "p99_ms": 200.0},
+            "p99_ratio": 2.0,
+        }
+        assert bench.check_continuous_against_committed(fresh) == 1
+        out = capsys.readouterr().out
+        assert "p99 ratio" in out and "REGRESSION" in out
+
+    def test_continuous_check_bites_on_bit_identity(self, capsys):
+        fresh = {
+            "engine": "stub",
+            "deadline": {"occupancy_mean": 0.6, "p99_ms": 100.0},
+            "continuous": {"occupancy_mean": 0.8, "p99_ms": 100.0},
+            "p99_ratio": 1.0,
+            "e2e": {"bit_identical": False},
+        }
+        assert bench.check_continuous_against_committed(fresh) == 1
+        assert "diverged" in capsys.readouterr().out

@@ -147,6 +147,62 @@ class TestWeights:
         load["p99_ms"] = 50.0
         assert replica_weight(load, p99_ref=100.0) == 0.5
 
+    def test_slot_occupancy_scales_the_weight(self):
+        """ISSUE 14: the weight formula consumes the free-slot load
+        fields — exact math.  A fully-claimed slot pool halves the
+        weight vs an idle pool; replicas that don't advertise slots get
+        the neutral factor 1 (deterministic tie-break: same fields,
+        same weight, always)."""
+        load = {
+            "accepting": True, "admission_capacity": 8,
+            "admission_qsize": 2, "inflight": 4, "p99_ms": None,
+        }
+        base = replica_weight(load)  # 0.5 (pinned above)
+        idle = dict(load, free_slots=8, slot_capacity=8)
+        full = dict(load, free_slots=0, slot_capacity=8)
+        half = dict(load, free_slots=4, slot_capacity=8)
+        assert replica_weight(idle) == base  # (1 + 8/8)/2 = 1.0
+        assert replica_weight(full) == base / 2  # (1 + 0)/2 = 0.5
+        assert replica_weight(half) == base * 0.75
+        # Determinism: identical fields → identical weight, every time.
+        assert replica_weight(dict(full)) == replica_weight(dict(full))
+
+    def test_fully_occupied_replica_loses_traffic_to_idle_one(self):
+        """The routing consequence, on the injectable clock: after one
+        poll, a replica advertising zero free slots takes measurably
+        less traffic than an idle twin with otherwise identical load."""
+        idle = FakeReplica("idle")
+        busy = FakeReplica("busy")
+        idle.slots = (4, 4)   # (free, capacity)
+        busy.slots = (0, 4)
+        orig_load = FakeReplica.load
+
+        def load_with_slots(self):
+            out = orig_load(self)
+            free, cap = getattr(self, "slots", (None, None))
+            if cap:
+                out["free_slots"] = free
+                out["slot_capacity"] = cap
+            return out
+
+        FakeReplica.load = load_with_slots
+        try:
+            router = make_router([idle, busy], seed=7)
+            try:
+                router.poll_once(now=100.0)
+                status = {
+                    r["replica_id"]: r for r in router.status()["replicas"]
+                }
+                assert status["idle"]["weight"] == 2 * status["busy"]["weight"] > 0
+                for _ in range(60):
+                    assert router.detect(b"payload") == DETS
+                # 2:1 weights: the idle replica must take the majority.
+                assert idle.detect_calls > busy.detect_calls > 0
+            finally:
+                router.close()
+        finally:
+            FakeReplica.load = orig_load
+
     def test_not_accepting_or_empty_is_unroutable(self):
         assert replica_weight(None) == 0.0
         assert replica_weight({}) == 0.0

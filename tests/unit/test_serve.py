@@ -66,21 +66,23 @@ def make_server(engine=None, **cfg) -> DetectionServer:
 
 class TestBatcher:
     def test_deadline_fires_with_partial_batch(self):
-        """A lone request must not wait for a full batch: the max-latency
-        deadline fires and it runs PADDED."""
+        """Deadline-only mode (``continuous=False``, the pre-ISSUE-14
+        path, kept alive): a lone request must not wait for a full batch
+        — the max-latency deadline fires and it runs PADDED."""
         engine = StubEngine(batch_sizes=(4,))
-        with make_server(engine) as srv:
+        with make_server(engine, continuous=False) as srv:
             t0 = time.perf_counter()
             assert srv.submit(IMG).result(timeout=10) == EXPECTED
             dt = time.perf_counter() - t0
             snap = srv.snapshot()
         assert engine.dispatched == [4]  # padded to the compiled size
         assert snap["deadline_fires"] >= 1
+        assert snap["ready_fires"] == 0  # no dispatch gate in this mode
         assert dt < 5.0  # deadline-bounded, not full-batch-bounded
 
     def test_full_batch_coalesces(self):
         engine = StubEngine(batch_sizes=(4,))
-        with make_server(engine, max_delay_ms=200) as srv:
+        with make_server(engine, max_delay_ms=200, continuous=False) as srv:
             futs = [srv.submit(IMG) for _ in range(8)]
             assert all(f.result(timeout=10) == EXPECTED for f in futs)
         assert sum(engine.dispatched) >= 8
@@ -108,6 +110,286 @@ class TestBatcher:
             first._event.wait(10)
             snap = srv.snapshot()
         assert snap["timeouts"] >= 1
+
+
+# ---- continuous in-flight batching (ISSUE 14) ----------------------------
+
+
+class FetchBlockEngine(StubEngine):
+    """Async-device model for continuous-mode tests: ``dispatch`` returns
+    immediately (the enqueue), ``fetch`` blocks until released per batch
+    — exactly how a real device round behaves to the dispatcher."""
+
+    def __init__(self, batch_sizes=(1, 2, 4)):
+        super().__init__(batch_sizes=batch_sizes)
+        self.gates: list[threading.Event] = []
+        self._lock = threading.Lock()
+
+    def release(self, i: int) -> None:
+        while True:
+            with self._lock:
+                if i < len(self.gates):
+                    self.gates[i].set()
+                    return
+            time.sleep(0.005)
+
+    def release_all(self) -> None:
+        with self._lock:
+            for g in self.gates:
+                g.set()
+            self._released_all = True
+
+    def dispatch(self, hw, images):
+        det = super().dispatch(hw, images)
+        with self._lock:
+            gate = threading.Event()
+            if getattr(self, "_released_all", False):
+                gate.set()
+            self.gates.append(gate)
+        return (gate, det)
+
+    def fetch(self, det):
+        gate, inner = det
+        assert gate.wait(30), "test forgot to release a batch"
+        return inner
+
+
+class TestContinuous:
+    def test_lone_request_skips_the_deadline(self):
+        """The dispatch gate seals a lone request the moment the device
+        is idle — light-load latency is one round, not deadline+round."""
+        engine = StubEngine(batch_sizes=(4,))
+        with make_server(engine, max_delay_ms=2000) as srv:
+            srv.submit(IMG).result(timeout=10)  # warm the thread path
+            t0 = time.perf_counter()
+            assert srv.submit(IMG).result(timeout=10) == EXPECTED
+            dt = time.perf_counter() - t0
+            snap = srv.snapshot()
+        assert dt < 1.0  # nowhere near the 2s deadline
+        assert snap["ready_fires"] >= 2
+        assert snap["deadline_fires"] == 0
+
+    def test_admission_into_assembling_batch_after_dispatch(self):
+        """Requests arriving AFTER batch N dispatched claim slots in the
+        assembling batch N+1 and ride together the instant N returns."""
+        engine = FetchBlockEngine()
+        srv = make_server(engine, max_delay_ms=10_000)
+        try:
+            a = srv.submit(IMG)  # seals alone (device idle), in flight
+            deadline = time.monotonic() + 10
+            while not engine.dispatched and time.monotonic() < deadline:
+                time.sleep(0.005)
+            assert engine.dispatched == [1]
+            b = srv.submit(IMG)  # claims the assembling batch...
+            c = srv.submit(IMG)  # ...and so does its friend
+            time.sleep(0.1)
+            # Nothing sealed yet (device busy, deadline far away): both
+            # rows sit CLAIMED in the pool.
+            assert engine.dispatched == [1]
+            assert srv.snapshot()["free_slots"] == 4 - 2
+            engine.release(0)  # batch N returns...
+            assert a.result(timeout=10) == EXPECTED
+            engine.release(1)
+            # ...and N+1 rides immediately with BOTH rows in one batch
+            # (batch size 2 — the smallest compiled fit).
+            assert b.result(timeout=10) == EXPECTED
+            assert c.result(timeout=10) == EXPECTED
+            assert engine.dispatched == [1, 2]
+            snap = srv.snapshot()
+            assert snap["ready_fires"] == 2
+            assert snap["deadline_fires"] == 0
+        finally:
+            engine.release_all()
+            srv.close(drain=False)
+
+    def test_early_row_completes_while_sibling_in_flight(self):
+        """Per-row completion release: batch N's futures resolve while
+        batch N+1 is still executing on device."""
+        engine = FetchBlockEngine()
+        srv = make_server(engine, max_delay_ms=10_000)
+        try:
+            a = srv.submit(IMG)
+            deadline = time.monotonic() + 10
+            while not engine.dispatched and time.monotonic() < deadline:
+                time.sleep(0.005)
+            b = srv.submit(IMG)
+            engine.release(0)  # N returns; N+1 (b) dispatches at once
+            assert a.result(timeout=10) == EXPECTED  # resolved...
+            assert not b.done()  # ...while its sibling is IN FLIGHT
+            engine.release(1)
+            assert b.result(timeout=10) == EXPECTED
+        finally:
+            engine.release_all()
+            srv.close(drain=False)
+
+    def test_drain_on_close_under_continuous(self):
+        """close(drain=True) completes claimed-but-unsealed slots too."""
+        engine = StubEngine(batch_sizes=(2,), delay_s=0.05)
+        srv = make_server(engine, max_delay_ms=50)
+        futs = [srv.submit(IMG) for _ in range(10)]
+        srv.close(drain=True)
+        assert all(f.result(timeout=1) == EXPECTED for f in futs)
+        assert srv.snapshot()["completed"] == 10
+
+    def test_rescue_seal_fires_despite_a_backlogged_dispatch_queue(self):
+        """Cross-bucket starvation guard: with the SHARED dispatch queue
+        held non-empty (a saturated sibling bucket) and the gate never
+        ready, a claimed row must still seal via the unconditional
+        deadline rescue — never held hostage to another bucket."""
+        import queue as queue_mod
+
+        from batchai_retinanet_horovod_coco_tpu.serve.batcher import (
+            BucketBatcher,
+        )
+        from batchai_retinanet_horovod_coco_tpu.serve.engine import (
+            DispatchGate,
+        )
+
+        engine = StubEngine(batch_sizes=(4,))
+        in_q: queue_mod.Queue = queue_mod.Queue()
+        out_q: queue_mod.Queue = queue_mod.Queue(maxsize=1)
+        out_q.put_nowait("sibling-batch")  # the queue never empties
+        stop = threading.Event()
+        rejected = []
+        fatal = []
+        batcher = BucketBatcher(
+            (64, 64), engine, in_q, out_q, max_delay_ms=50,
+            on_reject=lambda r, e: rejected.append(e),
+            on_fatal=fatal.append, stop=stop,
+            gate=DispatchGate(),  # never set ready
+        )
+        try:
+            from batchai_retinanet_horovod_coco_tpu.serve.common import (
+                ServeRequest,
+            )
+
+            req = ServeRequest(0, None, None)
+            req.image = IMG
+            req.scale = np.float32(1.0)
+            req.orig_wh = (64, 64)
+            in_q.put(req)
+            # rescue_at = deadline + max(0.1, max_delay) ≈ 150 ms; the
+            # batcher must seal (deadline_fires) and block on the put.
+            deadline = time.monotonic() + 5
+            while batcher.deadline_fires == 0 and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert batcher.deadline_fires == 1
+            assert batcher.pool.free_slots() == 4  # nothing orphaned
+            assert not fatal and not rejected
+        finally:
+            stop.set()
+            batcher.thread.join(timeout=10)
+
+    def test_occupancy_and_free_slots_surface(self):
+        """snapshot()/load_fields() carry the occupancy signals the
+        fleet router weighs on, and /metrics exposes the families."""
+        engine = StubEngine(batch_sizes=(4,))
+        with make_server(engine) as srv:
+            assert srv.submit(IMG).result(timeout=10) == EXPECTED
+            snap = srv.snapshot()
+            load = srv.load_fields()
+            text = srv.telemetry.prometheus_text()
+        assert snap["slot_capacity"] == 4
+        assert snap["free_slots"] == 4  # nothing assembling now
+        assert snap["occupancy_mean"] == 0.25  # 1 live row / 4-wide batch
+        assert load["free_slots"] == 4
+        assert load["slot_capacity"] == 4
+        assert load["occupancy"] == 0.25
+        assert "serve_free_slots 4" in text
+        assert "serve_batch_occupancy_mean 0.25" in text
+        assert "serve_ready_fires_total" in text
+        # Pull-only on the server's OWN registry — observable on every
+        # /metrics surface with no telemetry.enable() required.
+        assert "serve_slot_wait_ms_count 1" in text
+
+
+# ---- slot pool: eviction vs the dispatch window --------------------------
+
+
+class TestSlotPool:
+    def test_expired_claim_evicted_at_seal_frees_the_slot(self):
+        """The race the ISSUE 14 bugfix pins, on an injectable clock: a
+        claimed request whose deadline expires before the seal is
+        evicted AT the dispatch window — rejected with RequestTimeout,
+        slot freed, never a row in the sealed batch, no orphan."""
+        from batchai_retinanet_horovod_coco_tpu.serve.batcher import (
+            SlotPool,
+        )
+        from batchai_retinanet_horovod_coco_tpu.serve.common import (
+            ServeRequest,
+        )
+
+        clock = [100.0]
+        pool = SlotPool(4, now_fn=lambda: clock[0])
+        live = ServeRequest(0, None, deadline_t=200.0)
+        doomed = ServeRequest(1, None, deadline_t=100.5)
+        assert pool.claim(live) and pool.claim(doomed)
+        assert pool.free_slots() == 2
+        clock[0] = 101.0  # doomed's deadline passes INSIDE its slot
+        evicted = []
+        rows, waits = pool.seal(lambda req, exc: evicted.append((req, exc)))
+        assert rows == [live]
+        assert len(waits) == 1 and waits[0] == pytest.approx(1000.0)
+        assert [r.id for r, _ in evicted] == [1]
+        assert isinstance(evicted[0][1], RequestTimeout)
+        # No orphaned claimed slot: the pool is empty and re-armable.
+        assert pool.free_slots() == 4
+        assert pool.first_claim_t is None
+        assert pool.evictions == 1
+        assert pool.claim(ServeRequest(2, None, None))
+
+    def test_all_claims_expired_seals_to_nothing(self):
+        from batchai_retinanet_horovod_coco_tpu.serve.batcher import (
+            SlotPool,
+        )
+        from batchai_retinanet_horovod_coco_tpu.serve.common import (
+            ServeRequest,
+        )
+
+        clock = [10.0]
+        pool = SlotPool(2, now_fn=lambda: clock[0])
+        pool.claim(ServeRequest(0, None, deadline_t=10.1))
+        clock[0] = 11.0
+        evicted = []
+        rows, waits = pool.seal(lambda req, exc: evicted.append(req))
+        assert rows == [] and waits == []
+        assert len(evicted) == 1
+        assert pool.free_slots() == 2  # nothing orphaned, nothing rides
+
+
+# ---- telemetry record site (ISSUE 14 satellite) --------------------------
+
+
+class TestServeTelemetryRecordSite:
+    def test_disabled_path_records_nothing(self):
+        from batchai_retinanet_horovod_coco_tpu.obs import telemetry
+
+        telemetry.reset()
+        try:
+            telemetry.record_serve_batch(0.5, 3, (1.0, 2.0))
+            snap = telemetry.default().snapshot()
+            assert "serve_batch_occupancy.count" not in snap
+            assert "serve_free_slots" not in snap
+        finally:
+            telemetry.reset()
+
+    def test_enabled_families_land_on_the_process_registry(self):
+        from batchai_retinanet_horovod_coco_tpu.obs import telemetry
+
+        telemetry.reset()
+        try:
+            telemetry.enable()
+            telemetry.record_serve_batch(0.5, 3, (1.0, 2.0))
+            telemetry.record_serve_batch(1.0, 0, (4.0,))
+            snap = telemetry.default().snapshot()
+            assert snap["serve_batch_occupancy.count"] == 2
+            assert snap["serve_free_slots"] == 0
+            assert snap["serve_slot_wait_ms.count"] == 3
+            text = telemetry.default().prometheus_text()
+            assert "serve_batch_occupancy" in text
+            assert "serve_slot_wait_ms" in text
+        finally:
+            telemetry.reset()
 
 
 # ---- overload / shedding -------------------------------------------------
@@ -144,8 +426,10 @@ class TestShedding:
             assert done > 0
             assert snap["shed_total"] >= shed
             # bounded in-flight: outstanding can never exceed the queue
-            # bounds + what fits in the batcher/dispatcher stages
-            assert snap["outstanding"] <= 4 + 2 + 3 * 2 + 2
+            # bounds + what fits in the slot pool / dispatcher stages
+            # (admission 4 + bucket 2 + pool 2 + dispatch queue 2x2 +
+            # in-flight batch 2 + converting batch 2)
+            assert snap["outstanding"] <= 4 + 2 + 2 + 2 * 2 + 2 + 2
         finally:
             srv.close(drain=False)
 
@@ -362,13 +646,16 @@ def _decode(ds, rec) -> np.ndarray:
         return np.asarray(im.convert("RGB"), dtype=np.uint8)
 
 
+@pytest.mark.parametrize("continuous", [True, False], ids=["continuous", "deadline"])
 def test_served_detections_bit_identical_to_sequential_eval(
-    tiny_model_and_state, tiny_coco
+    tiny_model_and_state, tiny_coco, continuous
 ):
     """ACCEPTANCE: for the same images, the dynamic-batching server emits
     byte-for-byte the detections the sequential ``collect_detections``
     path does — same resize, same batch rows, same program, same
-    conversion."""
+    conversion.  Pinned in BOTH batching modes (ISSUE 14): continuous
+    slot-pool admission changes WHEN rows ride, never what they
+    compute; score_threshold 0.001 keeps the oracle non-vacuous."""
     from batchai_retinanet_horovod_coco_tpu.data import (
         PipelineConfig,
         build_pipeline,
@@ -403,7 +690,10 @@ def test_served_detections_bit_identical_to_sequential_eval(
         min_side=64, max_side=64, label_to_cat_id=ds.label_to_cat_id,
     )
     with DetectionServer(
-        engine, ServeConfig(max_delay_ms=50, preprocess_workers=1)
+        engine,
+        ServeConfig(
+            max_delay_ms=50, preprocess_workers=1, continuous=continuous
+        ),
     ) as srv:
         futs = [
             (rec.image_id, srv.submit(_decode(ds, rec)))

@@ -24,7 +24,7 @@ CLUSTER = python -m batchai_retinanet_horovod_coco_tpu.launch.cluster
 	convergence-full lint lint-obs check-static tune-smoke tunebench \
 	tunebench-check perf-report perf-report-check telemetry-smoke \
 	numerics-smoke chaos chaos-smoke chaos-comm ckptbench \
-	ckptbench-check fleet-smoke commbench commbench-check
+	ckptbench-check fleet-smoke fleet-obs-smoke commbench commbench-check
 
 create:
 	$(CLUSTER) create --name $(NAME) --zone $(ZONE) --accelerator $(ACCEL) $(DRYFLAG)
@@ -197,6 +197,18 @@ chaos-comm:
 fleet-smoke:
 	JAX_PLATFORMS=cpu python scripts/chaos.py --serve
 
+# Fleet observability smoke (ISSUE 15, scripts/fleet_obs_smoke.py): the
+# real fleet CLI + 2 stub replicas with --obs-trace on — SIGKILL one
+# replica (exactly ONE fleet-availability slo_violation, breaker readmits
+# the respawn), force a shed-driven re-dispatch with both replicas alive
+# (one trace id, serve_request spans on BOTH replica tracks of the merged
+# trace.json), check federated fleet /metrics equals each replica's own
+# exposition after quiescing, and run `obs.analyze --fleet` over the
+# artifacts — the verdict must NAME the killed replica.  CPU-only, no
+# dataset — wired into check-static.
+fleet-obs-smoke:
+	JAX_PLATFORMS=cpu python scripts/fleet_obs_smoke.py
+
 # CKPTBENCH (ISSUE 11): the two durability numbers — async-save overhead
 # (wall of N checkpointed steps vs the same N without) and resume
 # time-to-first-step — committed as CKPTBENCH.json.  ckptbench-check
@@ -215,8 +227,8 @@ ckptbench-check:
 # run without touching an accelerator (chaos-smoke DOES run a few real
 # CPU training subprocesses over generated synthetic data — budget the
 # job for minutes, not seconds).
-check-static: lint telemetry-smoke numerics-smoke chaos-smoke fleet-smoke
-	@echo "check-static: lint engine + watchdog audit + HLO collective audit + telemetry smoke + numerics smoke + chaos smoke + fleet smoke all green"
+check-static: lint telemetry-smoke numerics-smoke chaos-smoke fleet-smoke fleet-obs-smoke
+	@echo "check-static: lint engine + watchdog audit + HLO collective audit + telemetry smoke + numerics smoke + chaos smoke + fleet smoke + fleet obs smoke all green"
 
 # Static watchdog-coverage audit alone (ISSUE 3; now a shim over the lint
 # engine's watchdog-coverage rule — same CLI, same exit codes).  Also runs

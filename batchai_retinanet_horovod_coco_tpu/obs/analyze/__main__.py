@@ -23,6 +23,7 @@ import sys
 from batchai_retinanet_horovod_coco_tpu.obs.analyze.report import (
     AnalyzeError,
     analyze_dir,
+    analyze_fleet_dir,
     validate_report,
     write_report,
 )
@@ -132,6 +133,17 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--events", default="metrics.jsonl",
                     help="events JSONL name inside obs_dir (enrichment; "
                          "analysis proceeds without it)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="fleet mode (ISSUE 15): the obs dir is a fleet "
+                         "CLI run's — add the per-replica decomposition, "
+                         "time-weighted routing share, breaker/canary/"
+                         "re-dispatch timeline (from the merged trace) "
+                         "and the FLEET_METRICS.json cross-reference, "
+                         "with fleet verdicts (unavailable / most-shed / "
+                         "slowest replica) ranked into the bottlenecks")
+    ap.add_argument("--fleet-metrics", default="FLEET_METRICS.json",
+                    help="federated metrics dump name inside obs_dir "
+                         "(--fleet mode; analysis proceeds without it)")
     ap.add_argument("--out", default=None,
                     help="report path (default <obs_dir>/PERF_REPORT.json)")
     ap.add_argument("--print", action="store_true", dest="print_report",
@@ -151,9 +163,17 @@ def main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
 
     try:
-        report = analyze_dir(
-            args.obs_dir, trace_name=args.trace, events_name=args.events
-        )
+        if args.fleet:
+            report = analyze_fleet_dir(
+                args.obs_dir, trace_name=args.trace,
+                events_name=args.events,
+                metrics_name=args.fleet_metrics,
+            )
+        else:
+            report = analyze_dir(
+                args.obs_dir, trace_name=args.trace,
+                events_name=args.events,
+            )
     except AnalyzeError as e:
         print(f"# obs.analyze: {e}", file=sys.stderr)
         print(

@@ -1095,6 +1095,28 @@ def analyze_events(
     return report
 
 
+def _load_dir_inputs(
+    obs_dir: str,
+    trace_name: str,
+    events_name: str | None,
+    dump_name: str | None,
+) -> tuple[list[dict], dict, str | None, str | None]:
+    """The ONE obs-dir artifact resolver shared by ``analyze_dir`` and
+    ``analyze_fleet_dir`` (a divergence here would silently fork plain
+    and --fleet reports): (events, trace_health, events_path|None,
+    dump_path|None), optional inputs resolved to None when absent."""
+    events, health = load_trace(os.path.join(obs_dir, trace_name))
+    events_path = (
+        os.path.join(obs_dir, events_name) if events_name else None
+    )
+    if events_path and not os.path.exists(events_path):
+        events_path = None
+    dump_path = os.path.join(obs_dir, dump_name) if dump_name else None
+    if dump_path and not os.path.exists(dump_path):
+        dump_path = None
+    return events, health, events_path, dump_path
+
+
 def analyze_dir(
     obs_dir: str,
     trace_name: str = "trace.json",
@@ -1110,23 +1132,359 @@ def analyze_dir(
     records must not be attributed to this trace.  A NUMERICS_DUMP.json
     next to the trace (the loop's abort-path artifact) is
     cross-referenced into the numerics section when present."""
-    trace_path = os.path.join(obs_dir, trace_name)
-    events, health = load_trace(trace_path)
-    events_path = (
-        os.path.join(obs_dir, events_name) if events_name else None
+    events, health, events_path, dump_path = _load_dir_inputs(
+        obs_dir, trace_name, events_name, dump_name
     )
-    dump_path = os.path.join(obs_dir, dump_name) if dump_name else None
     report = analyze_events(
         events,
-        events_path=events_path
-        if events_path and os.path.exists(events_path)
-        else None,
+        events_path=events_path,
         trace_health=health,
-        dump_path=dump_path
-        if dump_path and os.path.exists(dump_path)
-        else None,
+        dump_path=dump_path,
     )
     report["source"]["trace"] = trace_name
+    return report
+
+
+# ---------------------------------------------------------------------------
+# Fleet mode (ISSUE 15): the merged multi-replica trace + federated metrics
+# ---------------------------------------------------------------------------
+
+# The fleet state transitions cross-referenced onto the report timeline —
+# every one of these is emitted as BOTH a sink event and a trace instant
+# carrying replica_id (serve/fleet.py), so the list is closed by design.
+_FLEET_EVENT_NAMES = (
+    "fleet_breaker_open",
+    "fleet_breaker_half_open",
+    "fleet_breaker_close",
+    "fleet_redispatch",
+    "canary_started",
+    "canary_rollback",
+    "canary_promoted",
+    "fleet_replica_spawned",
+    "fleet_replica_died",
+    "fleet_replica_respawned",
+    "fleet_respawn_failed",
+)
+
+# Serve stage-span families attributed per replica process track.
+_FLEET_STAGE_NAMES = (
+    "serve_preprocess",
+    "serve_assemble",
+    "serve_dispatch",
+    "serve_fetch",
+    "serve_convert",
+)
+
+_FLEET_TIMELINE_CAP = 500
+
+
+def _process_labels(events: Iterable[dict]) -> dict[Any, str]:
+    """pid → process label from the ``process_name`` metadata events
+    (``p<idx>:<label> (pid N)`` as obs/trace.py writes them)."""
+    out: dict[Any, str] = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            label = str((e.get("args") or {}).get("name") or "")
+            if " (pid " in label:
+                label = label.split(" (pid ", 1)[0]
+            if ":" in label:
+                label = label.split(":", 1)[1]
+            out[e.get("pid")] = label
+    return out
+
+
+def _fed_replica_metrics(metrics_doc: dict | None) -> dict[str, dict]:
+    """FLEET_METRICS.json → per-replica {completed, shed, p99_ms} from
+    the federated sample lists (serve/fleet.py ``dump_federated``)."""
+    out: dict[str, dict] = {}
+    for rid, rec in sorted(((metrics_doc or {}).get("replicas") or {}).items()):
+        completed = shed = 0.0
+        p99 = None
+        for name, labels, value in rec.get("samples") or []:
+            if name == "serve_requests_completed_total":
+                completed += float(value)
+            elif name == "serve_shed_total":
+                shed += float(value)
+            elif (
+                name == "serve_request_latency_ms"
+                and (labels or {}).get("quantile") == "0.99"
+            ):
+                p99 = float(value)
+        out[rid] = {
+            "completed": _r(completed, 1),
+            "shed": _r(shed, 1),
+            "p99_ms": _r(p99, 3),
+        }
+    return out
+
+
+def _fleet_section(
+    events: list[dict], metrics_doc: dict | None
+) -> dict:
+    """Per-replica decomposition + routing attribution + the fleet event
+    timeline — the read-back of a multi-replica run (ISSUE 15)."""
+    spans = _spans_by_name(events)
+    labels = _process_labels(events)
+    reqs = spans.get("serve_request") or []
+
+    by_replica: dict[str, list[dict]] = {}
+    replica_pids: dict[str, set] = {}
+    traces_by_replica: dict[str, set] = {}
+    for e in reqs:
+        args = e.get("args") or {}
+        rid = str(
+            args.get("replica") or labels.get(e.get("pid")) or "?"
+        )
+        by_replica.setdefault(rid, []).append(e)
+        replica_pids.setdefault(rid, set()).add(e.get("pid"))
+        if args.get("trace"):
+            traces_by_replica.setdefault(rid, set()).add(
+                str(args["trace"])
+            )
+    # A trace id whose spans landed on MORE THAN ONE replica is a
+    # re-dispatched (or shed-then-retried) request — the cross-track
+    # follow the tracing tentpole exists for.
+    trace_owners: dict[str, set] = {}
+    for rid, ids in traces_by_replica.items():
+        for t in ids:
+            trace_owners.setdefault(t, set()).add(rid)
+    redispatched = sorted(
+        t for t, owners in trace_owners.items() if len(owners) > 1
+    )
+
+    fed = _fed_replica_metrics(metrics_doc)
+    busy = {
+        rid: sum(_dur_s(e) for e in group)
+        for rid, group in by_replica.items()
+    }
+    busy_total = sum(busy.values())
+    # Stage spans carry no replica arg, only a pid: attribute a pid's
+    # stage time to a replica ONLY when that pid hosts exactly one
+    # replica (subprocess fleets).  An in-process LocalReplica fleet
+    # shares one pid across replicas — crediting each with the shared
+    # total would overcount N×, so those stages are skipped and flagged.
+    pid_owners: dict[Any, set] = {}
+    for rid, pids in replica_pids.items():
+        for pid in pids:
+            pid_owners.setdefault(pid, set()).add(rid)
+    replicas: dict[str, dict] = {}
+    for rid in sorted(set(by_replica) | set(fed)):
+        group = by_replica.get(rid) or []
+        entry: dict[str, Any] = {
+            "requests": len(group),
+            "busy_s": _r(busy.get(rid, 0.0), 4),
+            # Time-weighted routing-share attribution: this replica's
+            # share of all serve_request span time across the fleet.
+            "routing_share": _r(
+                busy.get(rid, 0.0) / busy_total if busy_total else 0.0
+            ),
+            "distinct_traces": len(traces_by_replica.get(rid) or ()),
+        }
+        if group:
+            entry["latency"] = latency_percentiles(
+                [_dur_s(e) * 1e3 for e in group]
+            )
+        all_pids = replica_pids.get(rid) or set()
+        pids = {p for p in all_pids if len(pid_owners.get(p) or ()) == 1}
+        if all_pids - pids:
+            entry["stages_shared_process"] = True
+        stages = {}
+        for name in _FLEET_STAGE_NAMES:
+            total = sum(
+                _dur_s(e)
+                for e in spans.get(name) or []
+                if e.get("pid") in pids
+            )
+            if total:
+                stages[name] = _r(total, 4)
+        if stages:
+            entry["stages_s"] = stages
+        if rid in fed:
+            entry["federated"] = fed[rid]
+        replicas[rid] = entry
+
+    timeline: list[dict] = []
+    event_counts: dict[str, dict[str, int]] = {}
+    for name in _FLEET_EVENT_NAMES:
+        for e in _instants(events, name):
+            args = e.get("args") or {}
+            rid = str(args.get("replica_id") or "?")
+            event_counts.setdefault(rid, {})
+            event_counts[rid][name] = event_counts[rid].get(name, 0) + 1
+            item = {"t_s": _r(_start_s(e), 3), "event": name}
+            for k in ("replica_id", "reason", "trace", "rc", "rule"):
+                if args.get(k) is not None:
+                    item[k] = args[k]
+            timeline.append(item)
+    timeline.sort(key=lambda x: (x["t_s"] or 0.0, x["event"]))
+    truncated = max(0, len(timeline) - _FLEET_TIMELINE_CAP)
+    return {
+        "available": bool(reqs or timeline or fed),
+        "replicas": replicas,
+        "events_by_replica": {
+            k: dict(sorted(v.items())) for k, v in sorted(event_counts.items())
+        },
+        "redispatched_traces": {
+            "count": len(redispatched),
+            "sample": redispatched[:10],
+        },
+        # The tail is what a post-mortem reads (the ring-buffer policy).
+        "timeline": timeline[-_FLEET_TIMELINE_CAP:],
+        "timeline_truncated": truncated,
+    }
+
+
+def _fleet_bottlenecks(fleet: dict) -> list[dict]:
+    """Fleet verdicts, same shape as every other bottleneck entry so the
+    schema-v3 machinery (``tune --from-report``, the checks) consumes
+    them unchanged: the UNAVAILABLE replica first (a lost replica has no
+    performance question left at fleet scope), then the most-shed and
+    the slowest replica."""
+    cands: list[dict] = []
+    counts = fleet.get("events_by_replica") or {}
+    death_score: dict[str, int] = {}
+    for rid, evs in counts.items():
+        if rid == "?":
+            continue
+        score = 2 * evs.get("fleet_replica_died", 0) + evs.get(
+            "fleet_breaker_open", 0
+        )
+        if score:
+            death_score[rid] = score
+    if death_score:
+        rid = max(sorted(death_score), key=lambda r: death_score[r])
+        evs = counts[rid]
+        cands.append(
+            {
+                "name": f"fleet:unavailable_replica:{rid}",
+                "score": 1.0,
+                "spans": ["fleet_breaker_open", "fleet_redispatch"],
+                "evidence": (
+                    f"replica {rid!r}: "
+                    f"{evs.get('fleet_replica_died', 0)} death(s), "
+                    f"{evs.get('fleet_breaker_open', 0)} breaker "
+                    f"open(s), "
+                    f"{evs.get('fleet_replica_respawned', 0)} respawn(s)"
+                ),
+                "suggestion": (
+                    "follow this replica's track in the merged trace "
+                    "around the breaker-open instants; the re-dispatch "
+                    "markers carry the affected trace ids"
+                ),
+                "tune_ops": [],
+            }
+        )
+    replicas = fleet.get("replicas") or {}
+    sheds = {
+        rid: float((r.get("federated") or {}).get("shed") or 0.0)
+        for rid, r in replicas.items()
+    }
+    if any(sheds.values()):
+        rid = max(sorted(sheds), key=lambda r: sheds[r])
+        done = float(
+            (replicas[rid].get("federated") or {}).get("completed") or 0.0
+        )
+        frac = sheds[rid] / max(1.0, sheds[rid] + done)
+        cands.append(
+            {
+                "name": f"fleet:shed_replica:{rid}",
+                "score": _r(min(1.0, frac)),
+                "spans": ["serve_request"],
+                "evidence": (
+                    f"replica {rid!r} shed {sheds[rid]:g} requests "
+                    f"({frac:.1%} of its traffic) — the fleet's worst"
+                ),
+                "suggestion": (
+                    "raise this replica's queue bounds or lower its "
+                    "routed share; a shedding replica under a healthy "
+                    "fleet is a capacity mismatch, not a kernel problem"
+                ),
+                "tune_ops": [],
+            }
+        )
+    p99s = {
+        rid: float(
+            (r.get("latency") or {}).get("p99_ms")
+            or (r.get("federated") or {}).get("p99_ms")
+            or 0.0
+        )
+        for rid, r in replicas.items()
+    }
+    p99s = {rid: v for rid, v in p99s.items() if v > 0}
+    if len(p99s) > 1:
+        rid = max(sorted(p99s), key=lambda r: p99s[r])
+        rest = sorted(v for r, v in p99s.items() if r != rid)
+        med = rest[len(rest) // 2]
+        if med > 0 and p99s[rid] > med:
+            cands.append(
+                {
+                    "name": f"fleet:slow_replica:{rid}",
+                    "score": _r(
+                        min(1.0, (p99s[rid] - med) / p99s[rid])
+                    ),
+                    "spans": ["serve_request", "serve_fetch"],
+                    "evidence": (
+                        f"replica {rid!r} p99 {p99s[rid]:.1f} ms vs "
+                        f"{med:.1f} ms at the rest of the fleet"
+                    ),
+                    "suggestion": (
+                        "compare this replica's serve stage spans "
+                        "against a healthy track; tune/ nms + batch on "
+                        "its device_kind if device-bound"
+                    ),
+                    "tune_ops": ["nms", "batch"],
+                }
+            )
+    cands = [c for c in cands if (c["score"] or 0) > 0]
+    cands.sort(key=lambda c: (-c["score"], c["name"]))
+    return cands
+
+
+def analyze_fleet_dir(
+    obs_dir: str,
+    trace_name: str = "trace.json",
+    events_name: str | None = "metrics.jsonl",
+    metrics_name: str | None = "FLEET_METRICS.json",
+    dump_name: str | None = "NUMERICS_DUMP.json",
+) -> dict:
+    """``obs/analyze --fleet``: the standard report over the MERGED
+    fleet trace, plus the ``fleet`` section (per-replica decomposition,
+    time-weighted routing share, breaker/canary/re-dispatch timeline,
+    federated metrics cross-reference) and fleet verdicts ranked into
+    ``bottlenecks`` with the same schema-v3 machinery — below declared
+    numerics/SLO breaches, above inferred single-process bottlenecks."""
+    events, health, events_path, dump_path = _load_dir_inputs(
+        obs_dir, trace_name, events_name, dump_name
+    )
+    report = analyze_events(
+        events,
+        events_path=events_path,
+        trace_health=health,
+        dump_path=dump_path,
+    )
+    metrics_doc = None
+    metrics_path = (
+        os.path.join(obs_dir, metrics_name) if metrics_name else None
+    )
+    if metrics_path and os.path.exists(metrics_path):
+        try:
+            with open(metrics_path) as f:
+                metrics_doc = json.load(f)
+        except (OSError, ValueError) as e:
+            report["health"]["fleet_metrics_error"] = repr(e)[:200]
+    fleet = _fleet_section(events, metrics_doc)
+    report["fleet"] = fleet
+    report["source"]["trace"] = trace_name
+    report["source"]["fleet_metrics"] = bool(metrics_doc)
+    def _is_head(b: dict) -> bool:
+        return str(b.get("name", "")).startswith(("numerics:", "slo:"))
+
+    heads = [b for b in report["bottlenecks"] if _is_head(b)]
+    rest = [b for b in report["bottlenecks"] if not _is_head(b)]
+    merged = heads + _fleet_bottlenecks(fleet) + rest
+    for i, b in enumerate(merged):
+        b["rank"] = i + 1
+    report["bottlenecks"] = merged
     return report
 
 

@@ -463,6 +463,34 @@ def grad_norm_spike(
     )
 
 
+def fleet_availability_rule(
+    floor: float = 0.999,
+    metric: str = "fleet_availability",
+    for_s: float = 0.0,
+) -> SloRule:
+    """Fleet-level availability floor (ISSUE 15): fires when the
+    fraction of ROUTABLE replicas (breaker CLOSED) over non-drained
+    replicas drops below ``floor`` — i.e. when ANY replica is lost, at
+    the default.  The metric is the fleet router's ``fleet_availability``
+    gauge on its federated registry; the anti-flap machinery makes a
+    replica death page exactly once per sustained loss (the breaker
+    readmitting the respawned replica heals the breach and, after
+    ``clear_s``, re-arms the rule).  Silent on registries without the
+    gauge, so it is safe to arm everywhere the fleet monitor runs."""
+    return SloRule(
+        name="fleet-availability",
+        metric=metric,
+        op="<",
+        threshold=floor,
+        for_s=for_s,
+        description=(
+            f"routable-replica fraction below {floor} (a replica's "
+            "breaker is open or the replica is gone; see the "
+            "fleet_breaker_open events on the timeline)"
+        ),
+    )
+
+
 def ef_residual_spike(
     factor: float = 10.0,
     window: int = 32,

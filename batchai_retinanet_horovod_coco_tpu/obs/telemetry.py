@@ -441,6 +441,50 @@ def parse_exposition(text: str) -> tuple[dict[str, str], dict[str, float]]:
     return types, samples
 
 
+_LABEL_PAIR_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape_label_value(v: str) -> str:
+    return re.sub(
+        r"\\(.)", lambda m: "\n" if m.group(1) == "n" else m.group(1), v
+    )
+
+
+def parse_exposition_samples(
+    text: str,
+) -> tuple[dict[str, str], list[tuple[str, dict[str, str], float]]]:
+    """The STRUCTURED reader for ``prometheus_text``: ``(types,
+    samples)`` where each sample is ``(family name, labels dict, value)``
+    with label values unescaped.  This is the metrics-federation parse
+    (ISSUE 15): the fleet router re-labels each replica's scraped series
+    with ``replica=<id>`` before re-exposing them, which needs the labels
+    as data, not as the raw brace string ``parse_exposition`` keeps."""
+    types: dict[str, str] = {}
+    samples: list[tuple[str, dict[str, str], float]] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3]
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        try:
+            value = float(m.group("value"))
+        except ValueError:
+            continue
+        labels = {
+            k: _unescape_label_value(v)
+            for k, v in _LABEL_PAIR_RE.findall(m.group("labels") or "")
+        }
+        samples.append((m.group("name"), labels, value))
+    return types, samples
+
+
 # ---------------------------------------------------------------------------
 # Built-in collectors
 # ---------------------------------------------------------------------------

@@ -64,6 +64,14 @@ OBS_PINDEX_ENV = "RETINANET_OBS_PINDEX"
 # trace.json.  Children inherit the parent's id via this env var.
 OBS_RUN_ENV = "RETINANET_OBS_RUN"
 
+# Cross-process request tracing (ISSUE 15): the fleet frontend mints one
+# fleet-wide trace id per request and carries it to replicas in this HTTP
+# header; replica frontends tag their ``serve_request`` span (and its flow
+# marker) with it and echo it back on the response, so one slow request is
+# followable edge → router → replica → response across the merged trace's
+# process tracks.
+TRACE_HEADER = "X-Retinanet-Trace"
+
 DEFAULT_CAPACITY = 65536
 
 # (wall, perf) anchor pair: monotonic_s() times map onto the shared wall
@@ -119,7 +127,8 @@ _generation = 0
 class _Ring:
     """One thread's bounded event buffer.  Events are tuples
     ``(ph, name, t_s, dur_s_or_value, args_or_None)`` with ``ph`` the
-    Chrome phase ("X" complete, "i" instant, "C" counter)."""
+    Chrome phase ("X" complete, "i" instant, "C" counter, "s"/"t"/"f"
+    flow start/step/end)."""
 
     __slots__ = ("events", "tid", "thread_name", "appended", "gen")
 
@@ -143,12 +152,36 @@ class _Ring:
         return self.appended - len(self.events)
 
 
+# Bound on distinct per-thread rings (= Perfetto tracks): request-scoped
+# spans on thread-per-request HTTP handler threads (the serve/fleet
+# frontends) would otherwise register one permanent ring per REQUEST for
+# the life of the process.  Threads beyond the cap share one overflow
+# ring — deque.append is GIL-atomic, so the only degradation is that
+# their spans merge onto a single labeled track instead of growing
+# memory without bound.
+MAX_RINGS = 4096
+_overflow_ring: "_Ring | None" = None
+
+
 def _ring() -> _Ring:
     r = getattr(_tls, "ring", None)
     if r is None or r.gen != _generation:  # stale after a reset()
-        r = _tls.ring = _Ring(_capacity)
+        global _overflow_ring
         with _registry_lock:
-            _rings.append(r)
+            at_cap = len(_rings) >= MAX_RINGS
+        if at_cap:
+            r = _overflow_ring
+            if r is None or r.gen != _generation:
+                r = _Ring(_capacity)
+                r.thread_name = "overflow (ring cap)"
+                with _registry_lock:
+                    _rings.append(r)
+                _overflow_ring = r
+            _tls.ring = r
+        else:
+            r = _tls.ring = _Ring(_capacity)
+            with _registry_lock:
+                _rings.append(r)
     return r
 
 
@@ -225,6 +258,35 @@ def counter(name: str, value: float) -> None:
     if not _enabled:
         return
     _ring().add(("C", name, monotonic_s(), float(value), None))
+
+
+def new_trace_id() -> str:
+    """Mint one fleet-wide request trace id (the value carried in
+    ``TRACE_HEADER`` and tagged onto every span the request touches)."""
+    return uuid.uuid4().hex[:16]
+
+
+def _flow(ph: str, name: str, flow_id) -> None:
+    if not _enabled:
+        return
+    _ring().add((ph, name, monotonic_s(), 0.0, {"id": str(flow_id)}))
+
+
+def flow_start(name: str, flow_id) -> None:
+    """Begin a Chrome flow (the arrow Perfetto draws between slices on
+    different tracks).  Emit INSIDE the slice the arrow should leave from
+    (binding is by enclosing slice); ``flow_step``/``flow_end`` with the
+    same (name, id) continue it on other threads/processes — the visual
+    follow-the-request mechanism for fleet traces."""
+    _flow("s", name, flow_id)
+
+
+def flow_step(name: str, flow_id) -> None:
+    _flow("t", name, flow_id)
+
+
+def flow_end(name: str, flow_id) -> None:
+    _flow("f", name, flow_id)
 
 
 def configure(
@@ -359,6 +421,15 @@ def _chrome_events() -> Iterator[dict]:
                     "ph": "C", "cat": "obs", "name": name, "ts": ts,
                     "pid": pid, "tid": ring.tid, "args": {"value": dur},
                 }
+            elif ph in ("s", "t", "f"):
+                # Flow events: same (cat, name, id) across processes link
+                # into one Perfetto arrow chain; "bp": "e" binds each to
+                # its enclosing slice on this track.
+                ev = {
+                    "ph": ph, "cat": "obs.flow", "name": name, "ts": ts,
+                    "pid": pid, "tid": ring.tid,
+                    "id": (args or {}).get("id"), "bp": "e",
+                }
             else:
                 ev = {
                     "ph": "i", "cat": "obs", "name": name, "ts": ts,
@@ -475,7 +546,8 @@ def reset() -> None:
     os.environ.pop(OBS_DIR_ENV, None)
     os.environ.pop(OBS_PINDEX_ENV, None)
     os.environ.pop(OBS_RUN_ENV, None)
-    global _generation
+    global _generation, _overflow_ring
+    _overflow_ring = None
     with _registry_lock:
         _rings.clear()
         # Invalidate EVERY thread's cached thread-local ring (not just the

@@ -150,7 +150,7 @@ class ServeRequest:
 
     __slots__ = (
         "id", "payload", "deadline_t", "future", "t_submit", "span",
-        "image", "scale", "orig_wh", "bucket",
+        "trace_id", "image", "scale", "orig_wh", "bucket",
     )
 
     def __init__(
@@ -158,6 +158,7 @@ class ServeRequest:
         request_id: int,
         payload: Any,  # np.ndarray HWC uint8, or encoded image bytes
         deadline_t: float | None,
+        trace_id: str | None = None,
     ):
         self.id = request_id
         self.payload = payload
@@ -165,6 +166,10 @@ class ServeRequest:
         self.future = DetectionFuture()
         self.t_submit = monotonic_s()
         self.span = None  # cross-thread trace handle (frontend owns it)
+        # Fleet-wide request trace id (ISSUE 15): carried in from the
+        # X-Retinanet-Trace header, tagged onto the serve_request span,
+        # echoed back on the HTTP response.  None on bare submits.
+        self.trace_id = trace_id
         # set by the router's preprocess:
         self.image: np.ndarray | None = None
         self.scale: np.float32 = np.float32(1.0)

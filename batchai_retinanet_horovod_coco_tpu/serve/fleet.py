@@ -48,20 +48,51 @@ Everything observable lands in ``router.telemetry`` (fleet latency
 summary, per-replica weight/breaker gauges, shed/redispatch/rollback
 counters) — scraped by ``GET /metrics`` on the fleet frontend
 (``serve_fleet_http``) exactly like a single replica's.
+
+Fleet-scope observability (ISSUE 15) — the read side learns there is
+more than one process:
+
+- **Distributed request tracing**: the fleet frontend mints one trace id
+  per request (``X-Retinanet-Trace``), wraps routing in a
+  ``fleet_request`` span carrying it, propagates it through the replica
+  handles to each replica frontend (whose ``serve_request`` span parents
+  under it), and echoes it on every response — so one slow request is
+  followable edge → router → replica slot → device → response in the
+  merged Perfetto trace, re-dispatches landing on the second replica's
+  track under the SAME id.
+- **Metrics federation**: a dedicated watchdog-registered scrape thread
+  pulls each replica's ``/metrics`` on the health-poll cadence
+  (``metrics_text()`` on the replica handles) and re-exposes every
+  series replica-labeled on the fleet registry, next to derived fleet
+  aggregates (``fleet_availability``, ``fleet_federated_p99_ms``,
+  ``fleet_federated_shed_total``) — one ``snapshot()`` the SLO monitor
+  evaluates fleet-level rules on (``obs.slo.fleet_availability_rule``).
+- **Event completeness**: every fleet state transition — breaker
+  open/half-open/readmit, re-dispatch, canary start/promote/rollback,
+  replica spawn/death/respawn — emits BOTH a structured sink event and a
+  ``trace.instant`` carrying the replica id, so fleet decisions sit on
+  the Perfetto timeline next to the request spans they explain.
+
+All of it is read-only: federation and tracing observe — they never
+alter routing weights, batching, or any per-request result (PARITY.md).
 """
 
 from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import random
 import sys
 import threading
 import zlib
 from typing import Any
 
-from batchai_retinanet_horovod_coco_tpu.obs import trace, watchdog
-from batchai_retinanet_horovod_coco_tpu.obs.telemetry import Registry
+from batchai_retinanet_horovod_coco_tpu.obs import telemetry, trace, watchdog
+from batchai_retinanet_horovod_coco_tpu.obs.telemetry import (
+    Registry,
+    parse_exposition_samples,
+)
 from batchai_retinanet_horovod_coco_tpu.obs.trace import monotonic_s
 from batchai_retinanet_horovod_coco_tpu.serve.common import (
     LatencyStats,
@@ -214,6 +245,12 @@ class FleetRouter:
         self._canary_monitor = None
         self._canary_outcome: str | None = None  # None|rolled_back|promoted
 
+        # Metrics federation (ISSUE 15): replica_id → (types, samples)
+        # from the last successful scrape of that replica's /metrics;
+        # re-exposed replica-labeled by _federation_samples.
+        self._federated: dict[str, tuple[dict, list]] = {}
+        self._fed_error: BaseException | None = None
+
         self.telemetry = Registry()
         self.telemetry.histogram(
             "fleet_request_latency_ms",
@@ -221,9 +258,15 @@ class FleetRouter:
             source=self.stats.window_ms,
         )
         self.telemetry.register_collector(self._telemetry_samples)
+        self.telemetry.register_collector(self._federation_samples)
+        # The fleet process's own health (poller / scrape / supervisor
+        # heartbeats) on the same scrape surface, so the built-in stall
+        # SLO rule works at the fleet edge too.
+        self.telemetry.register_collector(telemetry.watchdog_collector())
 
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self._fed_thread: threading.Thread | None = None
         if initial_poll:
             self.poll_once()
         if auto_poll:
@@ -246,6 +289,7 @@ class FleetRouter:
         breaker transitions, recompute weights.  Injectable ``now``."""
         now = monotonic_s() if now is None else now
         for st in list(self._states):
+            probing = False
             with self._lock:
                 if st.state == DRAINED:
                     continue
@@ -253,6 +297,14 @@ class FleetRouter:
                     if now < st.next_probe_t:
                         continue  # still backing off
                     st.state = HALF_OPEN  # this poll IS the probe
+                    probing = True
+            if probing:
+                # Half-open is a fleet decision too (ISSUE 15): on the
+                # timeline it explains the probe traffic that follows.
+                self._emit_event(
+                    "fleet_breaker_half_open",
+                    replica_id=st.replica.replica_id,
+                )
             try:
                 code, payload = st.replica.healthz()
             except Exception as exc:  # a poller can never crash on a replica
@@ -286,27 +338,27 @@ class FleetRouter:
                     )
             elif st.state == HALF_OPEN:
                 # Probe failed: back to OPEN with the next backoff step.
-                self._open_locked(st, now, reason="half_open_probe_failed",
-                                  quiet=True)
+                self._open_locked(st, now, reason="half_open_probe_failed")
 
     def _open_locked(
-        self, st: _ReplicaState, now: float, reason: str, quiet: bool = False
+        self, st: _ReplicaState, now: float, reason: str
     ) -> None:
         """Transition to OPEN and schedule the half-open probe (caller
-        holds the lock)."""
+        holds the lock).  EVERY open — including a failed half-open
+        probe re-opening — emits the event pair (ISSUE 15: no silent
+        fleet transitions)."""
         st.state = OPEN
         st.weight = 0.0
         delay = self._backoff_for(st).delay_s(st.open_count)
         st.open_count += 1
         st.next_probe_t = now + delay
         self._breaker_opens += 1
-        if not quiet:
-            self._emit_event(
-                "fleet_breaker_open",
-                replica_id=st.replica.replica_id,
-                reason=reason,
-                probe_in_s=round(delay, 3),
-            )
+        self._emit_event(
+            "fleet_breaker_open",
+            replica_id=st.replica.replica_id,
+            reason=reason,
+            probe_in_s=round(delay, 3),
+        )
 
     def _note_request_failure(self, st: _ReplicaState) -> None:
         """A request found this replica dead (``ReplicaUnavailable``):
@@ -380,13 +432,27 @@ class FleetRouter:
             ]
         return max(1, sum(caps))
 
-    def detect(self, payload, timeout_s: float | None = None) -> list[dict]:
+    def detect(
+        self,
+        payload,
+        timeout_s: float | None = None,
+        trace_id: str | None = None,
+    ) -> list[dict]:
         """Route one request; blocking.  Raises the serve taxonomy:
         ``RequestRejected(reason)`` on any shed (fleet edge or replica),
         ``RequestTimeout`` past the deadline, ``ServerError`` when every
-        eligible replica failed underneath it."""
+        eligible replica failed underneath it.
+
+        ``trace_id`` is the fleet-wide span context (ISSUE 15): minted
+        here when tracing is on and none was supplied, wrapped in a
+        ``fleet_request`` span on the edge track, and propagated to the
+        replica handles so each attempt's ``serve_request`` span parents
+        under the SAME id — a re-dispatched request's spans land on both
+        replicas' tracks, linked by one Perfetto flow."""
         self._raise_pending()
         t0 = monotonic_s()
+        if trace_id is None and trace.enabled():
+            trace_id = trace.new_trace_id()
         if timeout_s is None:
             timeout_s = self.config.default_timeout_s
         deadline = None if timeout_s is None else t0 + timeout_s
@@ -407,13 +473,28 @@ class FleetRouter:
             raise RequestRejected(
                 "fleet_overloaded", f"fleet inflight at capacity {cap}"
             )
+        span = (
+            trace.begin("fleet_request", trace=trace_id)
+            if trace_id is not None
+            else None
+        )
+        if trace_id is not None:
+            trace.flow_start("request", trace_id)
         try:
-            return self._dispatch(payload, deadline, t0)
+            return self._dispatch(payload, deadline, t0, trace_id)
         finally:
+            # Terminate the flow on EVERY exit — failed and re-dispatched
+            # requests are exactly the ones a post-mortem follows across
+            # tracks, so their arrow chain must close too.
+            if trace_id is not None:
+                trace.flow_end("request", trace_id)
+            trace.end(span)
             with self._lock:
                 self._inflight -= 1
 
-    def _dispatch(self, payload, deadline, t0: float) -> list[dict]:
+    def _dispatch(
+        self, payload, deadline, t0: float, trace_id: str | None = None
+    ) -> list[dict]:
         tried: set[int] = set()
         last_exc: BaseException | None = None
         attempts = self.config.redispatch_limit + 1
@@ -437,12 +518,23 @@ class FleetRouter:
             if attempt > 0:
                 with self._lock:
                     self._redispatches += 1
-                trace.instant(
-                    "fleet_redispatch", replica=st.replica.replica_id
+                # Sink event + trace instant (ISSUE 15): the re-dispatch
+                # carries the trace id, so the hop from replica A's shed/
+                # death to replica B's span is explicit on the timeline.
+                self._emit_event(
+                    "fleet_redispatch",
+                    replica_id=st.replica.replica_id,
+                    attempt=attempt,
+                    **({"trace": trace_id} if trace_id else {}),
                 )
             remaining = None if deadline is None else deadline - now
             try:
-                dets = st.replica.detect(payload, timeout_s=remaining)
+                if trace_id is None:
+                    dets = st.replica.detect(payload, timeout_s=remaining)
+                else:
+                    dets = st.replica.detect(
+                        payload, timeout_s=remaining, trace_id=trace_id
+                    )
             except ReplicaUnavailable as exc:
                 self._note_request_failure(st)
                 self._recompute_weights()
@@ -478,6 +570,141 @@ class FleetRouter:
     def _raise_pending(self) -> None:
         if self._error is not None:
             raise ServerError("fleet health poller crashed") from self._error
+
+    # ---- metrics federation (ISSUE 15) -----------------------------------
+
+    def scrape_metrics_once(self) -> None:
+        """One federation sweep: pull every non-drained replica's
+        ``/metrics`` (``metrics_text()`` on the handle — in-process or
+        HTTP) and cache the parsed samples for re-exposition.  A replica
+        that fails the scrape DROPS out of the federated view (stale
+        series must not masquerade as live), and handles without a
+        ``metrics_text`` surface are simply skipped — federation is
+        read-only and strictly optional per replica."""
+        with self._lock:
+            handles = [
+                (st.replica.replica_id, st.replica)
+                for st in self._states
+                if st.state != DRAINED
+            ]
+        for rid, replica in handles:
+            scrape = getattr(replica, "metrics_text", None)
+            text = None
+            if scrape is not None:
+                try:
+                    text = scrape()
+                except Exception:
+                    text = None  # a scrape can never crash the sweep
+            if text is None:
+                with self._lock:
+                    self._federated.pop(rid, None)
+                continue
+            parsed = parse_exposition_samples(text)
+            with self._lock:
+                self._federated[rid] = parsed
+
+    def _federation_samples(self):
+        """Scrape-time collector: the federated replica series,
+        replica-labeled, plus the derived fleet aggregates the SLO
+        monitor's fleet-level rules evaluate."""
+        with self._lock:
+            fed = dict(self._federated)
+        p99s: list[float] = []
+        shed_total = 0.0
+        for rid in sorted(fed):
+            types, samples = fed[rid]
+            for name, labels, value in samples:
+                kind = types.get(name, "untyped")
+                if kind == "summary":
+                    # Re-exposed quantile series are plain samples here
+                    # (the replica owns the summary's _count/_sum pair,
+                    # which ride through as their own untyped families).
+                    kind = "gauge"
+                lab = dict(labels)
+                lab["replica"] = rid
+                yield (
+                    name, kind, "federated from the replica's /metrics",
+                    lab, value,
+                )
+                if (
+                    name == "serve_request_latency_ms"
+                    and labels.get("quantile") == "0.99"
+                ):
+                    p99s.append(value)
+                elif name == "serve_shed_total":
+                    shed_total += value
+        if p99s:
+            yield (
+                "fleet_federated_p99_ms", "gauge",
+                "worst replica-local windowed p99 across the federated "
+                "scrape (the fleet-level aggregate p99 ceiling input)",
+                None, round(max(p99s), 4),
+            )
+        if fed:
+            yield (
+                "fleet_federated_shed_total", "gauge",
+                "requests shed across all federated replicas (sum of "
+                "the replica-local serve_shed_total series)",
+                None, shed_total,
+            )
+
+    def federated_snapshot(self) -> dict[str, float]:
+        """The flat fleet-scope metric view (``Registry.snapshot()`` over
+        the fleet registry): edge series, per-replica federated series
+        keyed ``name{...,replica="<id>"}``, and the fleet aggregates —
+        exactly what the SLO monitor evaluates fleet rules on."""
+        return self.telemetry.snapshot()
+
+    def dump_federated(self, path: str) -> str:
+        """Write FLEET_METRICS.json: the last federated scrape per
+        replica (parsed samples + TYPEs), the flat fleet snapshot, and
+        the router status — the metrics half ``obs/analyze --fleet``
+        consumes next to the merged trace."""
+        from batchai_retinanet_horovod_coco_tpu.utils.atomicio import (
+            atomic_write_json,
+        )
+
+        with self._lock:
+            fed = dict(self._federated)
+        doc = {
+            "replicas": {
+                rid: {
+                    "types": dict(types),
+                    "samples": [
+                        [name, dict(labels), value]
+                        for name, labels, value in samples
+                    ],
+                }
+                for rid, (types, samples) in sorted(fed.items())
+            },
+            "snapshot": self.federated_snapshot(),
+            "status": self.status(),
+        }
+        atomic_write_json(path, doc, indent=2, sort_keys=True)
+        return path
+
+    def _federation_run(self, hb: watchdog.Heartbeat) -> None:
+        try:
+            while not self._stop.wait(self.config.poll_interval_s):
+                hb.beat()
+                self.scrape_metrics_once()
+        except BaseException as e:
+            # Crash channel (thread-error-contract): a dead federation
+            # thread means frozen fleet metrics and silently disarmed
+            # fleet SLO rules — announce, record, re-raise.
+            self._fed_error = e
+            print(
+                json.dumps(
+                    {
+                        "event": "fleet_federation_crashed",
+                        "error": repr(e),
+                    }
+                ),
+                file=sys.stderr, flush=True,
+            )
+            raise
+        finally:
+            hb.close()
 
     # ---- canary gate -----------------------------------------------------
 
@@ -676,6 +903,23 @@ class FleetRouter:
         yield ("fleet_inflight", "gauge",
                "requests inside the fleet edge right now", None,
                float(inflight))
+        # Fleet-level availability (ISSUE 15): the fraction of non-
+        # drained replicas whose breaker is CLOSED — the metric the
+        # built-in fleet availability-floor SLO rule
+        # (obs.slo.fleet_availability_rule) evaluates.
+        active = [s for s in states if s[1] != DRAINED]
+        closed = sum(1 for s in active if s[1] == CLOSED)
+        yield ("fleet_replicas_routable", "gauge",
+               "replicas with a CLOSED breaker", None, float(closed))
+        yield ("fleet_replicas_total", "gauge",
+               "non-drained replicas in the fleet", None,
+               float(len(active)))
+        if active:
+            yield ("fleet_availability", "gauge",
+                   "routable replicas / non-drained replicas (1.0 = the "
+                   "whole fleet is healthy; the availability-floor SLO "
+                   "rule watches this)", None,
+                   round(closed / len(active), 4))
         for rid, state, weight, load, is_canary in states:
             yield ("fleet_replica_weight", "gauge",
                    "routing weight from advertised load fields",
@@ -721,6 +965,10 @@ class FleetRouter:
                 "breaker_opens": self._breaker_opens,
                 "canary_rollbacks": self._rollbacks,
                 "canary_outcome": self._canary_outcome,
+                "federated_replicas": sorted(self._federated),
+                "federation_error": (
+                    repr(self._fed_error) if self._fed_error else None
+                ),
             }
         out["replicas"] = replicas
         out["stats"] = self.stats.snapshot()
@@ -770,6 +1018,15 @@ class FleetRouter:
             name="fleet-health-poll",
         )
         self._thread.start()
+        # The federation scrape rides the same cadence on its own thread
+        # (a slow replica /metrics must not delay weight updates);
+        # watchdog-registered with the crash-announce contract above.
+        fed_hb = watchdog.register("fleet-metrics-scrape")
+        self._fed_thread = threading.Thread(
+            target=self._federation_run, args=(fed_hb,), daemon=True,
+            name="fleet-metrics-scrape",
+        )
+        self._fed_thread.start()
         return self
 
     def close(self, close_replicas: bool = False) -> None:
@@ -783,6 +1040,9 @@ class FleetRouter:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        if self._fed_thread is not None:
+            self._fed_thread.join(timeout=5)
+            self._fed_thread = None
         if self._canary_monitor is not None:
             self._canary_monitor.stop()
         if close_replicas:
@@ -825,10 +1085,16 @@ def serve_fleet_http(
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class Handler(BaseHTTPRequestHandler):
-        def _json(self, code: int, payload: dict) -> None:
+        def _json(
+            self, code: int, payload: dict, trace_id: str | None = None
+        ) -> None:
+            if trace_id is not None:
+                payload = {**payload, "trace_id": trace_id}
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
+            if trace_id is not None:
+                self.send_header(trace.TRACE_HEADER, trace_id)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -855,19 +1121,35 @@ def serve_fleet_http(
             if self.path != "/detect":
                 self._json(404, {"error": "not_found"})
                 return
+            # The fleet-wide trace id is minted HERE (or adopted from a
+            # client-supplied header) and echoed on every response —
+            # the whole request tree shares it (ISSUE 15).
+            trace_id = (
+                self.headers.get(trace.TRACE_HEADER) or trace.new_trace_id()
+            )
             length = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(length)
             try:
-                dets = router.detect(body, timeout_s=request_timeout_s)
+                dets = router.detect(
+                    body, timeout_s=request_timeout_s, trace_id=trace_id
+                )
             except RequestRejected as exc:
                 code = 400 if exc.reason == "decode_error" else 503
-                self._json(code, {"error": "rejected", "reason": exc.reason})
+                self._json(
+                    code, {"error": "rejected", "reason": exc.reason},
+                    trace_id=trace_id,
+                )
             except (RequestTimeout, TimeoutError):
-                self._json(504, {"error": "deadline_exceeded"})
+                self._json(
+                    504, {"error": "deadline_exceeded"}, trace_id=trace_id
+                )
             except Exception as exc:
-                self._json(500, {"error": "server_error", "detail": str(exc)})
+                self._json(
+                    500, {"error": "server_error", "detail": str(exc)},
+                    trace_id=trace_id,
+                )
             else:
-                self._json(200, {"detections": dets})
+                self._json(200, {"detections": dets}, trace_id=trace_id)
 
         def log_message(self, *args) -> None:
             pass  # request logging is the telemetry layer's job
@@ -884,6 +1166,8 @@ def serve_fleet_http(
 
 def build_parser():
     import argparse
+
+    from batchai_retinanet_horovod_coco_tpu.utils.cli import add_obs_flags
 
     p = argparse.ArgumentParser(
         description="Fleet router over N serve replicas: health-weighted "
@@ -927,21 +1211,71 @@ def build_parser():
     p.add_argument("--canary-p99-factor", type=float, default=1.5)
     p.add_argument("--canary-for-s", type=float, default=5.0)
     p.add_argument("--canary-poll-s", type=float, default=1.0)
+    p.add_argument("--shed-trip", type=int, default=3,
+                   help="CONSECUTIVE request-level sheds before a "
+                        "replica's breaker opens (sheds are load signals "
+                        "first; raise this in harnesses that shed on "
+                        "purpose so availability stays a death signal)")
+    p.add_argument("--spawn-serve-args", default=None, metavar="ARGS",
+                   help="extra serve-CLI arguments appended to EVERY "
+                        "spawned replica, as one shell-quoted string "
+                        "(e.g. '--serve-admission-queue 1'); smoke "
+                        "harnesses shape replica behavior with it")
+    p.add_argument("--availability-floor", type=float, default=None,
+                   metavar="FRAC",
+                   help="fleet SLO: fire when fleet_availability "
+                        "(routable/non-drained replicas) drops below "
+                        "FRAC (default 0.999 when the monitor runs — "
+                        "any replica loss pages exactly once per "
+                        "sustained loss)")
+    # Fleet observability (ISSUE 15): --obs-trace/--obs-dir enable the
+    # merged fleet trace (spawned replicas join via the env contract and
+    # export their own fragments, merged at exit) + the metrics.jsonl
+    # sink every fleet event lands in; --slo-rule/--obs-port run the SLO
+    # monitor / status server over the FEDERATED fleet registry.
+    add_obs_flags(p)
     return p
 
 
 def main(argv: list[str] | None = None) -> dict:
+    import shlex
     import signal
 
     from batchai_retinanet_horovod_coco_tpu.serve.replica import (
         HttpReplica,
         spawn_http_replica,
     )
+    from batchai_retinanet_horovod_coco_tpu.utils.cli import configure_obs
 
     args = build_parser().parse_args(argv)
     if args.spawn and not (args.export_dir or args.stub_engine):
         raise SystemExit("--spawn needs --export-dir or --stub-engine")
 
+    # Obs bring-up BEFORE any spawn: replica subprocesses inherit the
+    # RETINANET_OBS_DIR/RETINANET_OBS_RUN env contract, self-enable
+    # tracing under this run's id, and export fragments the finalize
+    # below merges into ONE fleet trace.json (ISSUE 15).
+    obs_dir = configure_obs(args, process_label="fleet")
+    sink = None
+    if obs_dir is not None:
+        from batchai_retinanet_horovod_coco_tpu.obs.events import EventSink
+
+        sink = EventSink(obs_dir, run_config=vars(args))
+        watchdog.default().sink = sink
+
+    def emit(kind: str, **fields) -> None:
+        """Supervision events: stdout line (the chaos harness parses
+        these) + trace instant + sink record (ISSUE 15 — replica
+        lifecycle is a fleet decision like any breaker transition)."""
+        print(json.dumps({"event": kind, **fields}), flush=True)
+        trace.instant(kind, **fields)
+        if sink is not None:
+            try:
+                sink.event(kind, **fields)
+            except Exception:
+                pass  # a broken sink must not mask the stdout line
+
+    spawn_extra = shlex.split(args.spawn_serve_args or "")
     replicas: list = [HttpReplica(url) for url in args.replica]
     procs: dict[str, tuple] = {}  # replica_id -> (proc, port, kwargs)
 
@@ -950,13 +1284,14 @@ def main(argv: list[str] | None = None) -> dict:
             rid, port=port,
             export_dir=args.export_dir,
             stub_delay_ms=args.stub_delay_ms if args.stub_engine else None,
+            extra_args=spawn_extra,
         )
         port = int(rep.base_url.rsplit(":", 1)[1])
         procs[rid] = (proc, port)
-        print(json.dumps({
-            "event": "fleet_replica_spawned",
-            "replica_id": rid, "pid": proc.pid, "port": port,
-        }), flush=True)
+        emit(
+            "fleet_replica_spawned",
+            replica_id=rid, pid=proc.pid, port=port,
+        )
         return rep
 
     for k in range(args.spawn):
@@ -971,8 +1306,46 @@ def main(argv: list[str] | None = None) -> dict:
         canary_p99_factor=args.canary_p99_factor,
         canary_for_s=args.canary_for_s,
         canary_poll_s=args.canary_poll_s,
+        shed_trip=args.shed_trip,
     )
-    router = FleetRouter(replicas, config)
+    router = FleetRouter(replicas, config, sink=sink)
+
+    # Fleet SLO monitor over the FEDERATED registry (ISSUE 15): built-in
+    # availability floor + watchdog stall, plus any --slo-rule specs —
+    # the same grammar/machinery as train/serve, evaluated on
+    # router.federated_snapshot()'s key space.
+    slo_monitor = None
+    status_server = None
+    if (
+        obs_dir is not None
+        or getattr(args, "slo_rule", None)
+        or getattr(args, "obs_port", None) is not None
+    ):
+        from batchai_retinanet_horovod_coco_tpu.obs import slo as slo_lib
+
+        slo_monitor = slo_lib.SloMonitor(
+            router.telemetry,
+            [
+                slo_lib.fleet_availability_rule(
+                    args.availability_floor
+                    if args.availability_floor is not None
+                    else 0.999
+                ),
+                slo_lib.stall_rule(),
+            ]
+            + [slo_lib.parse_rule(s) for s in (args.slo_rule or [])],
+            sink=sink,
+            poll_interval=args.slo_poll_s,
+        ).start()
+    if getattr(args, "obs_port", None) is not None:
+        status_server = telemetry.start_http_server(
+            router.telemetry, port=args.obs_port, host=args.host
+        )
+        print(
+            f"fleet telemetry on http://{status_server.host}:"
+            f"{status_server.port} (/metrics /healthz /statusz)",
+            flush=True,
+        )
 
     canary_proc = None
     if args.canary_url or args.canary_export_dir or (
@@ -986,11 +1359,11 @@ def main(argv: list[str] | None = None) -> dict:
                 export_dir=args.canary_export_dir,
                 stub_delay_ms=args.canary_stub_delay_ms,
             )
-            print(json.dumps({
-                "event": "fleet_replica_spawned",
-                "replica_id": "canary", "pid": canary_proc.pid,
-                "port": int(canary.base_url.rsplit(":", 1)[1]),
-            }), flush=True)
+            emit(
+                "fleet_replica_spawned",
+                replica_id="canary", pid=canary_proc.pid,
+                port=int(canary.base_url.rsplit(":", 1)[1]),
+            )
         router.add_canary(canary, start_monitor=True)
 
     stop_supervising = threading.Event()
@@ -1004,10 +1377,10 @@ def main(argv: list[str] | None = None) -> dict:
                 for rid, (proc, port) in list(procs.items()):
                     if proc.poll() is None:
                         continue
-                    print(json.dumps({
-                        "event": "fleet_replica_died",
-                        "replica_id": rid, "rc": proc.returncode,
-                    }), flush=True)
+                    emit(
+                        "fleet_replica_died",
+                        replica_id=rid, rc=proc.returncode,
+                    )
                     try:
                         new_proc, _rep = spawn_http_replica(
                             rid, port=port,
@@ -1016,19 +1389,19 @@ def main(argv: list[str] | None = None) -> dict:
                                 args.stub_delay_ms
                                 if args.stub_engine else None
                             ),
+                            extra_args=spawn_extra,
                         )
                     except Exception as exc:
-                        print(json.dumps({
-                            "event": "fleet_respawn_failed",
-                            "replica_id": rid, "error": repr(exc),
-                        }), flush=True)
+                        emit(
+                            "fleet_respawn_failed",
+                            replica_id=rid, error=repr(exc),
+                        )
                         continue
                     procs[rid] = (new_proc, port)
-                    print(json.dumps({
-                        "event": "fleet_replica_respawned",
-                        "replica_id": rid, "pid": new_proc.pid,
-                        "port": port,
-                    }), flush=True)
+                    emit(
+                        "fleet_replica_respawned",
+                        replica_id=rid, pid=new_proc.pid, port=port,
+                    )
         except BaseException as e:
             # Crash channel: a silently-dead supervisor means no respawns.
             print(json.dumps({
@@ -1072,6 +1445,29 @@ def main(argv: list[str] | None = None) -> dict:
             supervisor.join(timeout=10)
         httpd.shutdown()
         httpd.server_close()
+        if obs_dir is not None:
+            # Final federation sweep while the replicas are still alive,
+            # then the metrics half of the fleet report (the trace half
+            # merges below, after the replicas export their fragments).
+            try:
+                router.scrape_metrics_once()
+                router.dump_federated(
+                    os.path.join(obs_dir, "FLEET_METRICS.json")
+                )
+            except Exception as exc:
+                print(
+                    json.dumps(
+                        {
+                            "event": "fleet_metrics_dump_error",
+                            "error": repr(exc)[:300],
+                        }
+                    ),
+                    file=sys.stderr, flush=True,
+                )
+        if slo_monitor is not None:
+            slo_monitor.stop()
+        if status_server is not None:
+            status_server.close()
         router.close()
         for rid, (proc, _port) in procs.items():
             if proc.poll() is None:
@@ -1086,6 +1482,15 @@ def main(argv: list[str] | None = None) -> dict:
                 canary_proc.wait(timeout=10)
             except Exception:
                 canary_proc.kill()
+        if sink is not None:
+            sink.close()
+        if obs_dir is not None:
+            # Replicas SIGTERMed above exported their per-process trace
+            # fragments under this run's id — the merge stitches fleet +
+            # every replica into one Perfetto-loadable trace.json.
+            from batchai_retinanet_horovod_coco_tpu import obs
+
+            obs.finalize()
     status = router.status()
     print(json.dumps({"fleet_stats": status["stats"]}), flush=True)
     return status

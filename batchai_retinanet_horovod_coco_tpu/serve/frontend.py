@@ -197,10 +197,17 @@ class DetectionServer:
         self,
         image,
         timeout_s: float | None = None,
+        trace_id: str | None = None,
     ) -> DetectionFuture:
         """Enqueue one image (HWC uint8 array or encoded bytes); returns a
         future.  Raises ``RequestRejected`` when shed at admission,
-        ``ServerClosed`` after close, ``ServerError`` after a crash."""
+        ``ServerClosed`` after close, ``ServerError`` after a crash.
+
+        ``trace_id`` (ISSUE 15) parents this request's ``serve_request``
+        span under a fleet-wide trace: the span's args carry it (plus the
+        replica id, so a merged fleet trace attributes every request span
+        to its replica even where process labels are ambiguous) and a
+        flow step links it to the fleet edge's span in Perfetto."""
         self._raise_pending()
         if timeout_s is None:
             timeout_s = self.config.default_timeout_s
@@ -208,8 +215,18 @@ class DetectionServer:
             next(self._ids),
             image,
             None if timeout_s is None else monotonic_s() + timeout_s,
+            trace_id=trace_id,
         )
-        req.span = trace.begin("serve_request", id=req.id)
+        if trace_id is None:
+            req.span = trace.begin(
+                "serve_request", id=req.id, replica=self.replica_id
+            )
+        else:
+            req.span = trace.begin(
+                "serve_request", id=req.id, replica=self.replica_id,
+                trace=trace_id,
+            )
+            trace.flow_step("request", trace_id)
         # The accepting check and the registration must share ONE lock
         # acquisition: close()/_fail() flip _accepting and then reject
         # everything registered, so a request registered after a lock-free
@@ -551,6 +568,13 @@ def serve_http(
                    component otherwise (read-only probe; the watchdog
                    poll thread keeps its one-dump-per-stall latch)
 
+    Request tracing (ISSUE 15): an ``X-Retinanet-Trace`` request header
+    (minted here when absent) parents the request's ``serve_request``
+    span; EVERY /detect response — success, shed, timeout, crash —
+    echoes it back as the same header plus a ``trace_id`` JSON field, so
+    a client or bench log can correlate a slow response with its span in
+    the merged fleet trace.
+
     ``request_timeout_s`` bounds each handler's wait on its future — an
     HTTP client must never hang on a wedged pipeline (the watchdog names
     the wedge; the client gets a 504).  Returns the ``http.server``
@@ -560,10 +584,16 @@ def serve_http(
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     class Handler(BaseHTTPRequestHandler):
-        def _json(self, code: int, payload: dict) -> None:
+        def _json(
+            self, code: int, payload: dict, trace_id: str | None = None
+        ) -> None:
+            if trace_id is not None:
+                payload = {**payload, "trace_id": trace_id}
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
+            if trace_id is not None:
+                self.send_header(trace.TRACE_HEADER, trace_id)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
@@ -592,22 +622,37 @@ def serve_http(
             if self.path != "/detect":
                 self._json(404, {"error": "not_found"})
                 return
+            # The propagated fleet trace id (minted here for direct
+            # clients) — every response branch echoes it (ISSUE 15).
+            trace_id = (
+                self.headers.get(trace.TRACE_HEADER) or trace.new_trace_id()
+            )
             length = int(self.headers.get("Content-Length", 0))
             body = self.rfile.read(length)
             try:
-                dets = server.submit(body).result(timeout=request_timeout_s)
+                dets = server.submit(body, trace_id=trace_id).result(
+                    timeout=request_timeout_s
+                )
             except RequestRejected as exc:
                 # The taxonomy distinction in status codes: a bad INPUT is
                 # the client's fault and not retryable (400); shed load is
                 # transient and retryable (503).
                 code = 400 if exc.reason == "decode_error" else 503
-                self._json(code, {"error": "rejected", "reason": exc.reason})
+                self._json(
+                    code, {"error": "rejected", "reason": exc.reason},
+                    trace_id=trace_id,
+                )
             except (RequestTimeout, TimeoutError):
-                self._json(504, {"error": "deadline_exceeded"})
+                self._json(
+                    504, {"error": "deadline_exceeded"}, trace_id=trace_id
+                )
             except ServeError as exc:
-                self._json(500, {"error": "server_error", "detail": str(exc)})
+                self._json(
+                    500, {"error": "server_error", "detail": str(exc)},
+                    trace_id=trace_id,
+                )
             else:
-                self._json(200, {"detections": dets})
+                self._json(200, {"detections": dets}, trace_id=trace_id)
 
         def log_message(self, *args) -> None:
             pass  # request logging is the stats/obs layer's job
@@ -664,6 +709,7 @@ def build_parser():
 
 def main(argv: list[str] | None = None) -> dict:
     import os
+    import signal
 
     args = build_parser().parse_args(argv)
 
@@ -677,7 +723,26 @@ def main(argv: list[str] | None = None) -> dict:
         make_serve_config,
     )
 
-    obs_dir = configure_obs(args, process_label="serve")
+    # Replica-labeled process track in the merged fleet trace (ISSUE 15):
+    # the per-process trace file and its Perfetto process group carry the
+    # replica id, not a generic "serve".
+    process_label = getattr(args, "replica_id", None) or "serve"
+    obs_dir = configure_obs(args, process_label=process_label)
+    # Fleet-spawned replicas join the parent's RETINANET_OBS_DIR export
+    # contract (the shm-worker mechanism): tracing self-enables under the
+    # parent's run id, this process exports its own trace fragment at
+    # exit, and the fleet CLI's finalize merges it onto the fleet
+    # timeline.  Explicit --obs-trace/--obs-dir flags win.
+    joined_env = obs_dir is None and trace.maybe_configure_from_env(
+        process_label
+    )
+    # The fleet CLI stops replicas with SIGTERM: exit through the same
+    # finally as an interrupt so the trace fragment is exported and the
+    # server drains instead of dying mid-request.
+    def _on_sigterm(_signum, _frame):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
     if args.stub_engine:
         from batchai_retinanet_horovod_coco_tpu.serve.stub import (
             StubDetectEngine,
@@ -823,6 +888,10 @@ def main(argv: list[str] | None = None) -> dict:
             from batchai_retinanet_horovod_coco_tpu import obs
 
             obs.finalize()
+        elif joined_env:
+            # Env-joined (fleet-spawned) replica: export THIS process's
+            # fragment only — the fleet parent owns the merge.
+            trace.export()
 
 
 if __name__ == "__main__":

@@ -32,7 +32,7 @@ import sys
 import urllib.error
 import urllib.request
 
-from batchai_retinanet_horovod_coco_tpu.obs import telemetry
+from batchai_retinanet_horovod_coco_tpu.obs import telemetry, trace
 from batchai_retinanet_horovod_coco_tpu.serve.common import (
     RequestRejected,
     RequestTimeout,
@@ -87,9 +87,16 @@ class LocalReplica:
         payload["load"] = load
         return code, payload
 
-    def detect(self, payload, timeout_s: float | None = None) -> list[dict]:
+    def detect(
+        self,
+        payload,
+        timeout_s: float | None = None,
+        trace_id: str | None = None,
+    ) -> list[dict]:
         try:
-            fut = self._server.submit(payload, timeout_s=timeout_s)
+            fut = self._server.submit(
+                payload, timeout_s=timeout_s, trace_id=trace_id
+            )
             return fut.result(timeout=timeout_s)
         except (ServerClosed, ServerError) as exc:
             raise ReplicaUnavailable(
@@ -97,6 +104,21 @@ class LocalReplica:
             ) from exc
         except TimeoutError as exc:  # future wait expired
             raise RequestTimeout(str(exc)) from exc
+
+    def metrics_text(self) -> str | None:
+        """This replica's Prometheus exposition — the federation scrape
+        surface (ISSUE 15; same payload the HTTP frontend's /metrics
+        serves).  None = unscrapable this sweep, never raises.  A closed
+        or crashed server reports None like a dead HTTP replica would:
+        its registry object outlives it, and a frozen exposition must
+        DROP from the federated view, not masquerade as live."""
+        srv = self._server
+        if srv._error is not None or getattr(srv, "_closed", False):
+            return None
+        try:
+            return srv.telemetry.prometheus_text()
+        except Exception:
+            return None
 
     def drain(self, timeout_s: float = 5.0) -> None:
         """Stop accepting, let in-flight finish (bounded) — the canary
@@ -161,7 +183,12 @@ class HttpReplica:
             self._version = str(load.get("version") or self._version)
         return code, payload
 
-    def detect(self, payload, timeout_s: float | None = None) -> list[dict]:
+    def detect(
+        self,
+        payload,
+        timeout_s: float | None = None,
+        trace_id: str | None = None,
+    ) -> list[dict]:
         if not isinstance(payload, (bytes, bytearray, memoryview)):
             raise RequestRejected(
                 "decode_error", "HTTP replicas take encoded image bytes"
@@ -169,6 +196,10 @@ class HttpReplica:
         req = urllib.request.Request(
             f"{self.base_url}/detect", data=bytes(payload), method="POST"
         )
+        if trace_id is not None:
+            # The cross-process span-context hop (ISSUE 15): the replica
+            # frontend parents its serve_request span under this id.
+            req.add_header(trace.TRACE_HEADER, trace_id)
         timeout = self._timeout_s if timeout_s is None else timeout_s
         try:
             with urllib.request.urlopen(req, timeout=timeout) as r:
@@ -202,6 +233,18 @@ class HttpReplica:
             raise ReplicaUnavailable(
                 f"replica {self.replica_id} unreachable: {e!r}"
             ) from e
+
+    def metrics_text(self) -> str | None:
+        """GET /metrics — the federation scrape surface (ISSUE 15).
+        Health-probe timeout bound (the scrape sweep is serial, like the
+        health poll); None on any failure, never raises."""
+        try:
+            with urllib.request.urlopen(
+                f"{self.base_url}/metrics", timeout=self._health_timeout_s
+            ) as r:
+                return r.read().decode()
+        except Exception:
+            return None
 
     def drain(self, timeout_s: float = 5.0) -> None:
         # No remote admin surface: "drain" for an HTTP replica is the

@@ -2014,6 +2014,42 @@ def run_fleet_leg() -> dict:
         if router.status()["canary_rollbacks"] >= 1:
             break
     status = router.status()
+
+    # Federated-vs-local p99 consistency (ISSUE 15): with traffic
+    # quiesced, one federation sweep — each surviving replica's OWN
+    # windowed p99 must round-trip the fleet scrape EXACTLY (both sides
+    # read the same LatencyStats window through the same percentile
+    # helper; any delta means federation re-labeled or lost samples).
+    router.scrape_metrics_once()
+    fed = router.federated_snapshot()
+    fed_checked = 0
+    fed_max_delta = 0.0
+    fed_consistent = True
+    for s in servers[1:]:  # servers[0] was killed mid-leg
+        local = s.telemetry.snapshot().get("serve_request_latency_ms.p99")
+        if local is None:
+            continue
+        fed_p99 = fed.get(
+            "serve_request_latency_ms"
+            f'{{quantile="0.99",replica="{s.replica_id}"}}'
+        )
+        fed_checked += 1
+        if fed_p99 is None:
+            fed_consistent = False
+            print(
+                f"# fleet leg: replica {s.replica_id} missing from the "
+                "federated scrape", flush=True,
+            )
+            continue
+        delta = abs(float(fed_p99) - float(local))
+        fed_max_delta = max(fed_max_delta, delta)
+        if delta > 1e-9:
+            fed_consistent = False
+            print(
+                f"# fleet leg: federated p99 {fed_p99} != local {local} "
+                f"on {s.replica_id}", flush=True,
+            )
+
     router.close()
     for s in servers:
         s.close(drain=False)
@@ -2038,6 +2074,12 @@ def run_fleet_leg() -> dict:
         "redispatches": status["redispatches"],
         "breaker_opens": status["breaker_opens"],
         "canary_rollbacks": status["canary_rollbacks"],
+        # Metrics-federation consistency (ISSUE 15): the fleet-scraped,
+        # replica-labeled p99 equals each surviving replica's own
+        # registry value on a quiesced fleet.
+        "federated_p99_consistent": fed_consistent and fed_checked > 0,
+        "federated_replicas_checked": fed_checked,
+        "federated_p99_max_delta_ms": round(fed_max_delta, 6),
     }
 
 
@@ -2082,6 +2124,14 @@ def check_fleet_against_committed(fresh: dict | None) -> int:
         print(
             f"# servebench-check[fleet]: expected exactly 1 canary "
             f"rollback, measured {fresh['canary_rollbacks']}: REGRESSION"
+        )
+        rc = 1
+    if fresh.get("federated_p99_consistent") is False:
+        print(
+            "# servebench-check[fleet]: federated /metrics p99 diverged "
+            "from the replicas' own registries "
+            f"(max delta {fresh.get('federated_p99_max_delta_ms')} ms): "
+            "REGRESSION"
         )
         rc = 1
     if rc == 0:

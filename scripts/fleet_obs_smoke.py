@@ -1,0 +1,440 @@
+"""Fleet observability smoke (ISSUE 15, `make fleet-obs-smoke`).
+
+The REAL fleet CLI over 2 stub-engine replica subprocesses with the obs
+plane on (`--obs-trace --obs-dir`), proving the acceptance chain end to
+end on CPU:
+
+1. **kill leg** — SIGKILL replica-0 mid-run: the breaker opens, the
+   built-in fleet availability SLO fires EXACTLY ONCE, the supervisor
+   respawns the replica in place and the half-open probe readmits it;
+2. **re-dispatch leg** — with both replicas alive again, bursts against
+   tiny bucket queues force a replica-level shed that re-dispatches to
+   the sibling: one trace id, ``serve_request`` spans on BOTH replicas;
+3. **trace-id echo** — every /detect response (200 and 503) carries the
+   ``X-Retinanet-Trace`` header + ``trace_id`` field;
+4. **federated metrics consistency** — after quiescing, the fleet
+   ``/metrics`` replica-labeled series EQUAL each replica's own
+   exposition (counters are frozen, so equality is exact);
+5. **artifacts** — one merged ``trace.json`` with fleet + both replica
+   process tracks and a re-dispatched trace id spanning two replicas;
+   ``FLEET_METRICS.json``; ``metrics.jsonl`` with exactly one
+   ``slo_violation`` for ``fleet-availability``; and an
+   ``obs/analyze --fleet`` report whose verdict NAMES the killed
+   replica.
+
+CPU-only, no dataset, no device work — wired into `make check-static`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+FAILURES: list[str] = []
+
+
+def check(ok: bool, what: str) -> None:
+    tag = "ok" if ok else "FAIL"
+    print(f"fleet-obs-smoke {tag}: {what}", flush=True)
+    if not ok:
+        FAILURES.append(what)
+
+
+def _png_bytes() -> bytes:
+    import io
+
+    import numpy as np
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(np.zeros((64, 64, 3), np.uint8)).save(buf, "PNG")
+    return buf.getvalue()
+
+
+def _http(url: str, data: bytes | None = None, headers: dict | None = None,
+          timeout: float = 30.0):
+    """(status, headers dict, body bytes); 4xx/5xx are data."""
+    req = urllib.request.Request(
+        url, data=data, method="POST" if data is not None else "GET"
+    )
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def _wait_until(predicate, timeout: float, what: str) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if predicate():
+                return True
+        except Exception:
+            pass
+        time.sleep(0.2)
+    check(False, what)
+    return False
+
+
+class Fleet:
+    """The fleet CLI under test + structured stdout/stderr readers."""
+
+    def __init__(self, obs_dir: str):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        self.proc = subprocess.Popen(
+            [
+                sys.executable, "-m",
+                "batchai_retinanet_horovod_coco_tpu.serve.fleet",
+                "--http", "0", "--spawn", "2", "--stub-engine",
+                "--stub-delay-ms", "120",
+                "--poll-interval", "0.2", "--respawn-delay-s", "0.5",
+                "--fleet-timeout-s", "20",
+                # Sheds stay LOAD signals in this harness (the re-dispatch
+                # leg sheds on purpose): only the SIGKILL may open a
+                # breaker, so availability fires exactly once.
+                "--shed-trip", "1000000",
+                "--spawn-serve-args",
+                "--serve-bucket-queue 1 --serve-workers 1 "
+                "--serve-max-delay-ms 20",
+                "--obs-trace", "--obs-dir", obs_dir,
+                "--slo-poll-s", "0.2",
+            ],
+            env=env, cwd=_REPO, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        self.stdout_lines: list[str] = []
+        self.stderr_lines: list[str] = []
+
+        def reader(stream, into):
+            try:
+                for line in stream:
+                    into.append(line.rstrip("\n"))
+            except Exception as e:
+                into.append(f"__reader_error__ {e!r}")
+
+        # watchdog: harness-local pipe readers; liveness is witnessed by
+        # the driver's own bounded waits, not the obs watchdog.
+        for stream, into in (
+            (self.proc.stdout, self.stdout_lines),
+            (self.proc.stderr, self.stderr_lines),
+        ):
+            threading.Thread(
+                target=reader, args=(stream, into), daemon=True
+            ).start()
+        try:
+            self.base_url = self._wait_for_url()
+        except Exception:
+            self.stop()
+            raise
+
+    def _wait_for_url(self, timeout: float = 180.0) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"fleet CLI died rc={self.proc.returncode}: "
+                    f"{self.stderr_lines[-5:]}"
+                )
+            for line in self.stdout_lines:
+                if line.startswith("fleet serving on "):
+                    return line.split("fleet serving on ", 1)[1].split()[0]
+            time.sleep(0.1)
+        raise RuntimeError("fleet CLI never started serving")
+
+    def events(self, kind: str) -> list[dict]:
+        out = []
+        for line in self.stdout_lines + self.stderr_lines:
+            try:
+                rec = json.loads(line)
+            except (json.JSONDecodeError, ValueError):
+                continue
+            if isinstance(rec, dict) and rec.get("event") == kind:
+                out.append(rec)
+        return out
+
+    def status(self) -> dict:
+        code, _h, body = _http(f"{self.base_url}/fleet")
+        return json.loads(body.decode()) if code == 200 else {}
+
+    def metric(self, key: str) -> float:
+        from batchai_retinanet_horovod_coco_tpu.obs.telemetry import (
+            parse_exposition,
+        )
+
+        code, _h, body = _http(f"{self.base_url}/metrics")
+        if code != 200:
+            return float("nan")
+        _types, samples = parse_exposition(body.decode())
+        return samples.get(key, 0.0)
+
+    def stop(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=10)
+
+
+def _burst(base_url: str, payload: bytes, n: int, clients: int) -> dict:
+    """n concurrent-ish requests; every response must echo a trace id."""
+    counts = {"ok": 0, "shed": 0, "other": 0, "no_echo": 0}
+    lock = threading.Lock()
+    issued = [0]
+
+    def client():
+        try:
+            while True:
+                with lock:
+                    if issued[0] >= n:
+                        return
+                    issued[0] += 1
+                code, headers, body = _http(
+                    f"{base_url}/detect", data=payload
+                )
+                try:
+                    doc = json.loads(body.decode())
+                except ValueError:
+                    doc = {}
+                echoed = bool(doc.get("trace_id")) and bool(
+                    headers.get("X-Retinanet-Trace")
+                )
+                with lock:
+                    if not echoed:
+                        counts["no_echo"] += 1
+                    if code == 200:
+                        counts["ok"] += 1
+                    elif code == 503:
+                        counts["shed"] += 1
+                    else:
+                        counts["other"] += 1
+        except Exception as e:
+            # Crash channel: a dead client must fail the burst loudly,
+            # not leave the driver waiting on requests never issued.
+            with lock:
+                counts["other"] += 1
+            print(f"fleet-obs-smoke FAIL: burst client crashed: {e!r}",
+                  flush=True)
+            raise
+
+    # watchdog: harness-local load generators, bounded by the joins below.
+    threads = [
+        threading.Thread(target=client, daemon=True)
+        for _ in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    return counts
+
+
+def main() -> int:
+    obs_dir = tempfile.mkdtemp(prefix="fleet_obs_smoke_")
+    print(f"fleet-obs-smoke: obs dir {obs_dir}", flush=True)
+    payload = _png_bytes()
+    fleet = Fleet(obs_dir)
+    victim_rid = None
+    try:
+        spawned = fleet.events("fleet_replica_spawned")
+        check(len(spawned) == 2, f"2 replicas spawned (saw {len(spawned)})")
+
+        # Explicit header round-trip at the fleet edge.
+        code, headers, body = _http(
+            f"{fleet.base_url}/detect", data=payload,
+            headers={"X-Retinanet-Trace": "smoke-trace-1"},
+        )
+        doc = json.loads(body.decode())
+        check(
+            code == 200 and doc.get("trace_id") == "smoke-trace-1"
+            and headers.get("X-Retinanet-Trace") == "smoke-trace-1",
+            "client trace id echoed on header + JSON field",
+        )
+
+        # ---- kill leg: exactly-one availability SLO violation ----------
+        victim = spawned[0]
+        victim_rid = victim["replica_id"]
+        os.kill(victim["pid"], signal.SIGKILL)
+        _wait_until(
+            lambda: fleet.metric(
+                'slo_violations_total{rule="fleet-availability"}'
+            ) == 1.0,
+            30, "availability SLO fired after the kill",
+        )
+        _wait_until(
+            lambda: len(fleet.events("fleet_replica_respawned")) >= 1,
+            60, "victim respawned",
+        )
+        _wait_until(
+            lambda: all(
+                r["state"] == "closed"
+                for r in fleet.status().get("replicas", [])
+            ),
+            60, "breaker readmitted the respawned victim",
+        )
+
+        # ---- re-dispatch leg: both replicas ALIVE (so both export their
+        # trace fragments), tiny bucket queues force replica-level sheds
+        # that re-dispatch onto the sibling under one trace id.
+        before = fleet.metric("fleet_redispatch_total")
+        for _ in range(20):
+            counts = _burst(fleet.base_url, payload, n=24, clients=12)
+            check(counts["no_echo"] == 0,
+                  f"every response echoed a trace id: {counts}")
+            if counts["other"]:
+                check(False, f"unexpected response codes: {counts}")
+            if fleet.metric("fleet_redispatch_total") > before:
+                break
+        check(
+            fleet.metric("fleet_redispatch_total") > before,
+            "a replica-level shed re-dispatched onto the sibling",
+        )
+
+        # ---- quiesce, then federated-vs-local consistency --------------
+        time.sleep(1.5)  # a few scrape cycles with zero traffic
+        ports = {
+            e["replica_id"]: e["port"]
+            for e in fleet.events("fleet_replica_spawned")
+            + fleet.events("fleet_replica_respawned")
+        }
+        from batchai_retinanet_horovod_coco_tpu.obs.telemetry import (
+            parse_exposition,
+        )
+
+        for rid, port in sorted(ports.items()):
+            code, _h, body = _http(f"http://127.0.0.1:{port}/metrics")
+            check(code == 200, f"{rid} /metrics scrapeable")
+            _t, local = parse_exposition(body.decode())
+            local_done = local.get("serve_requests_completed_total", 0.0)
+            fed_done = fleet.metric(
+                f'serve_requests_completed_total{{replica="{rid}"}}'
+            )
+            check(
+                fed_done == local_done and local_done > 0,
+                f"federated completed_total == {rid}'s own "
+                f"({fed_done} vs {local_done})",
+            )
+
+        check(
+            fleet.metric(
+                'slo_violations_total{rule="fleet-availability"}'
+            ) == 1.0,
+            "availability SLO fired EXACTLY once end-to-end",
+        )
+    finally:
+        fleet.stop()
+
+    # ---- merged artifacts --------------------------------------------
+    trace_path = os.path.join(obs_dir, "trace.json")
+    check(os.path.exists(trace_path), "merged trace.json written")
+    with open(trace_path) as f:
+        merged = json.load(f)
+    events = merged.get("traceEvents") or []
+    check(len(merged.get("otherData", {}).get("merged_from", [])) >= 3,
+          "merge stitched >= 3 process fragments (fleet + 2 replicas)")
+    labels = {
+        str((e.get("args") or {}).get("name"))
+        for e in events
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+    }
+    for rid in ("replica-0", "replica-1"):
+        check(any(rid in lb for lb in labels),
+              f"{rid} has its own process track in the merged trace")
+    by_trace: dict[str, set] = {}
+    for e in events:
+        if e.get("ph") == "X" and e.get("name") == "serve_request":
+            args = e.get("args") or {}
+            if args.get("trace"):
+                by_trace.setdefault(str(args["trace"]), set()).add(
+                    str(args.get("replica"))
+                )
+    multi = [t for t, rids in by_trace.items() if len(rids) > 1]
+    check(
+        bool(multi),
+        "a re-dispatched request's serve_request spans appear on BOTH "
+        f"replicas' tracks under one trace id ({len(multi)} such ids)",
+    )
+    check(
+        os.path.exists(os.path.join(obs_dir, "FLEET_METRICS.json")),
+        "FLEET_METRICS.json written",
+    )
+    metrics_jsonl = os.path.join(obs_dir, "metrics.jsonl")
+    violations = []
+    if os.path.exists(metrics_jsonl):
+        with open(metrics_jsonl) as f:
+            for line in f:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if (
+                    rec.get("event") == "slo_violation"
+                    and rec.get("rule") == "fleet-availability"
+                ):
+                    violations.append(rec)
+    check(
+        len(violations) == 1,
+        f"metrics.jsonl carries exactly one availability slo_violation "
+        f"(saw {len(violations)})",
+    )
+
+    # ---- the fleet perf report ----------------------------------------
+    rc = subprocess.run(
+        [
+            sys.executable, "-m",
+            "batchai_retinanet_horovod_coco_tpu.obs.analyze",
+            obs_dir, "--fleet",
+        ],
+        cwd=_REPO, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    ).returncode
+    check(rc == 0, f"obs.analyze --fleet exits 0 (rc={rc})")
+    report_path = os.path.join(obs_dir, "PERF_REPORT.json")
+    check(os.path.exists(report_path), "fleet PERF_REPORT.json written")
+    if os.path.exists(report_path):
+        with open(report_path) as f:
+            report = json.load(f)
+        names = [b.get("name") for b in report.get("bottlenecks", [])]
+        check(
+            f"fleet:unavailable_replica:{victim_rid}" in names,
+            f"the verdict names the killed replica ({names[:4]})",
+        )
+        rules = (report.get("violations") or {}).get("rules") or {}
+        check(
+            rules.get("fleet-availability", {}).get("count") == 1,
+            "the report's violations section pins the one availability "
+            "breach",
+        )
+        fleet_sec = report.get("fleet") or {}
+        check(
+            fleet_sec.get("redispatched_traces", {}).get("count", 0) >= 1,
+            "the report counts the re-dispatched trace id(s)",
+        )
+
+    if FAILURES:
+        print(
+            f"fleet-obs-smoke: {len(FAILURES)} FAILURE(S): {FAILURES}",
+            flush=True,
+        )
+        return 1
+    print(f"fleet-obs-smoke: all checks green ({obs_dir})", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,681 @@
+"""Fleet-scope observability (ISSUE 15): cross-process request tracing,
+metrics federation, merged fleet traces, fleet SLO rules.
+
+Families:
+
+- header propagation end-to-end: a trace id POSTed at the fleet edge is
+  carried through ``HttpReplica`` to real replica frontends, tagged onto
+  their ``serve_request`` spans, and echoed back on every response;
+- metrics federation: each replica's Prometheus exposition round-trips
+  through the fleet scrape replica-labeled with EQUAL values, plus the
+  derived fleet aggregates the SLO monitor evaluates;
+- merged fleet trace: valid Chrome schema with disjoint per-replica
+  tracks, and a shed-then-redispatched request's ``serve_request`` spans
+  landing on BOTH replicas under ONE trace id;
+- fleet SLO: the availability-floor rule fires EXACTLY ONCE on an
+  injected replica stall (injectable clock — no sleeps);
+- the ``obs/analyze --fleet`` report: per-replica decomposition, the
+  event timeline, and a verdict naming the killed replica.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from batchai_retinanet_horovod_coco_tpu.obs import slo, telemetry, trace
+from batchai_retinanet_horovod_coco_tpu.obs.analyze.report import (
+    analyze_fleet_dir,
+    validate_report,
+)
+from batchai_retinanet_horovod_coco_tpu.obs.telemetry import (
+    parse_exposition_samples,
+)
+from batchai_retinanet_horovod_coco_tpu.serve import (
+    DetectionServer,
+    FleetConfig,
+    FleetRouter,
+    HttpReplica,
+    LocalReplica,
+    ServeConfig,
+    serve_http,
+)
+from batchai_retinanet_horovod_coco_tpu.serve.fleet import (
+    CLOSED,
+    serve_fleet_http,
+)
+from batchai_retinanet_horovod_coco_tpu.serve.stub import (
+    EXPECTED_DETECTIONS,
+    StubDetectEngine,
+)
+from batchai_retinanet_horovod_coco_tpu.utils.backoff import BackoffPolicy
+
+IMG = np.zeros((64, 64, 3), np.uint8)
+
+EXACT_BACKOFF = BackoffPolicy(
+    max_tries=1_000_000, base_s=1.0, multiplier=2.0, ceiling_s=8.0,
+    jitter=0.0,
+)
+
+
+@pytest.fixture(autouse=True)
+def _obs_state():
+    telemetry.reset()
+    trace.reset()
+    yield
+    telemetry.reset()
+    trace.reset()
+
+
+def make_server(rid: str, **cfg) -> DetectionServer:
+    cfg.setdefault("max_delay_ms", 10)
+    cfg.setdefault("preprocess_workers", 1)
+    return DetectionServer(
+        StubDetectEngine(), ServeConfig(**cfg), replica_id=rid
+    )
+
+
+def make_router(replicas, **cfg) -> FleetRouter:
+    cfg.setdefault("probe_backoff", EXACT_BACKOFF)
+    cfg.setdefault("poll_interval_s", 0.05)
+    return FleetRouter(replicas, FleetConfig(**cfg), auto_poll=False)
+
+
+def _png_bytes() -> bytes:
+    import io
+
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(IMG).save(buf, "PNG")
+    return buf.getvalue()
+
+
+def _post(url: str, data: bytes, headers: dict | None = None):
+    """(status, headers, payload dict) — HTTP errors are data here."""
+    req = urllib.request.Request(url, data=data, method="POST")
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, dict(r.headers), json.load(r)
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read().decode())
+
+
+def _serve_request_spans() -> list[dict]:
+    return [
+        e
+        for e in trace.snapshot_events()
+        if e.get("ph") == "X" and e.get("name") == "serve_request"
+    ]
+
+
+# ---- header propagation end-to-end (two real HTTP replicas) --------------
+
+
+class TestHeaderPropagation:
+    def test_trace_id_flows_edge_to_replicas_and_back(self, tmp_path):
+        trace.configure(str(tmp_path))
+        servers = [make_server("prop-r0"), make_server("prop-r1")]
+        httpds, threads = [], []
+        try:
+            for srv in servers:
+                httpd = serve_http(srv)
+                # watchdog: test-local HTTP listener, bounded by shutdown.
+                t = threading.Thread(target=httpd.serve_forever, daemon=True)
+                t.start()
+                httpds.append(httpd)
+                threads.append(t)
+            replicas = [
+                HttpReplica(
+                    f"http://{h.server_address[0]}:{h.server_address[1]}",
+                    replica_id=srv.replica_id,
+                )
+                for h, srv in zip(httpds, servers)
+            ]
+            router = make_router(replicas)
+            fleet_httpd = serve_fleet_http(router)
+            # watchdog: test-local HTTP listener, bounded by shutdown.
+            ft = threading.Thread(
+                target=fleet_httpd.serve_forever, daemon=True
+            )
+            ft.start()
+            base = (
+                f"http://{fleet_httpd.server_address[0]}:"
+                f"{fleet_httpd.server_address[1]}"
+            )
+            try:
+                # A client-supplied id round-trips: response header AND
+                # JSON field echo it verbatim.
+                code, headers, payload = _post(
+                    f"{base}/detect", _png_bytes(),
+                    {trace.TRACE_HEADER: "client-trace-1"},
+                )
+                assert code == 200
+                assert payload["detections"] == EXPECTED_DETECTIONS
+                assert payload["trace_id"] == "client-trace-1"
+                assert headers.get(trace.TRACE_HEADER) == "client-trace-1"
+                # No header: the fleet edge mints one and still echoes.
+                code, headers, payload = _post(
+                    f"{base}/detect", _png_bytes()
+                )
+                assert code == 200
+                minted = payload["trace_id"]
+                assert minted and headers.get(trace.TRACE_HEADER) == minted
+                # The replica frontends (same process here) tagged their
+                # serve_request spans with the propagated ids.
+                spans = _serve_request_spans()
+                tagged = {
+                    (e["args"].get("trace"), e["args"].get("replica"))
+                    for e in spans
+                    if e.get("args", {}).get("trace")
+                }
+                assert any(t == "client-trace-1" for t, _ in tagged)
+                assert any(t == minted for t, _ in tagged)
+                # Replica frontends echo directly too (satellite: clients
+                # of a single replica correlate without the fleet).
+                rep_base = replicas[0].base_url
+                code, headers, payload = _post(
+                    f"{rep_base}/detect", _png_bytes(),
+                    {trace.TRACE_HEADER: "direct-1"},
+                )
+                assert code == 200
+                assert payload["trace_id"] == "direct-1"
+                assert headers.get(trace.TRACE_HEADER) == "direct-1"
+            finally:
+                fleet_httpd.shutdown()
+                fleet_httpd.server_close()
+                router.close()
+        finally:
+            for httpd in httpds:
+                httpd.shutdown()
+                httpd.server_close()
+            for srv in servers:
+                srv.close(drain=False)
+
+    def test_error_responses_echo_the_trace_id(self):
+        srv = make_server("prop-err")
+        try:
+            httpd = serve_http(srv)
+            # watchdog: test-local HTTP listener, bounded by shutdown.
+            t = threading.Thread(target=httpd.serve_forever, daemon=True)
+            t.start()
+            base = (
+                f"http://{httpd.server_address[0]}:"
+                f"{httpd.server_address[1]}"
+            )
+            try:
+                code, headers, payload = _post(
+                    f"{base}/detect", b"garbage",
+                    {trace.TRACE_HEADER: "bad-input-1"},
+                )
+                assert code == 400
+                assert payload["reason"] == "decode_error"
+                assert payload["trace_id"] == "bad-input-1"
+                assert headers.get(trace.TRACE_HEADER) == "bad-input-1"
+            finally:
+                httpd.shutdown()
+                httpd.server_close()
+        finally:
+            srv.close(drain=False)
+
+
+# ---- metrics federation --------------------------------------------------
+
+
+class TestFederation:
+    def test_federated_scrape_round_trips_each_replica_registry(self):
+        servers = [make_server("fed-r0"), make_server("fed-r1")]
+        replicas = [LocalReplica(s) for s in servers]
+        router = make_router(replicas)
+        try:
+            for _ in range(3):
+                assert (
+                    router.detect(IMG, timeout_s=20) == EXPECTED_DETECTIONS
+                )
+            # Freeze each replica's exposition so the equality below is
+            # exact (live registries move between scrapes — ages, new
+            # requests); the round-trip under test is parse → re-label →
+            # re-expose, not clock stability.
+            frozen = {}
+            for rep in replicas:
+                text = rep.metrics_text()
+                frozen[rep.replica_id] = text
+                rep.metrics_text = (lambda t=text: t)  # type: ignore
+            router.scrape_metrics_once()
+            fleet_types, fleet_samples = parse_exposition_samples(
+                router.telemetry.prometheus_text()
+            )
+            fleet_by_key = {
+                (name, tuple(sorted(labels.items()))): value
+                for name, labels, value in fleet_samples
+            }
+            for rid, text in frozen.items():
+                _types, samples = parse_exposition_samples(text)
+                assert samples, f"replica {rid} exposed nothing"
+                for name, labels, value in samples:
+                    key = (
+                        name,
+                        tuple(sorted({**labels, "replica": rid}.items())),
+                    )
+                    assert key in fleet_by_key, (
+                        f"federated /metrics lost {name}{labels} of {rid}"
+                    )
+                    assert fleet_by_key[key] == pytest.approx(value), (
+                        f"federated value drifted for {name}{labels}"
+                    )
+            # Derived aggregates: worst federated p99 + fleet availability
+            # land in the SAME snapshot the SLO monitor evaluates.
+            snap = router.federated_snapshot()
+            p99s = [
+                v
+                for (name, labels), v in fleet_by_key.items()
+                if name == "serve_request_latency_ms"
+                and ("quantile", "0.99") in labels
+            ]
+            assert snap["fleet_federated_p99_ms"] == pytest.approx(
+                max(p99s)
+            )
+            assert snap["fleet_availability"] == 1.0
+            for rid in frozen:
+                assert (
+                    snap[
+                        "serve_requests_completed_total"
+                        f'{{replica="{rid}"}}'
+                    ]
+                    >= 1.0
+                )
+        finally:
+            router.close()
+            for s in servers:
+                s.close(drain=False)
+
+    def test_closed_local_replica_drops_from_federation(self):
+        """A closed in-process server's registry object outlives it —
+        its frozen exposition must DROP like a dead HTTP replica's."""
+        servers = [make_server("fed-c0"), make_server("fed-c1")]
+        replicas = [LocalReplica(s) for s in servers]
+        router = make_router(replicas)
+        try:
+            router.scrape_metrics_once()
+            assert set(router.status()["federated_replicas"]) == {
+                "fed-c0", "fed-c1",
+            }
+            servers[0].close(drain=False)
+            router.scrape_metrics_once()
+            assert router.status()["federated_replicas"] == ["fed-c1"]
+        finally:
+            router.close()
+            for s in servers:
+                s.close(drain=False)
+
+    def test_failed_scrape_drops_the_replica_not_the_sweep(self):
+        servers = [make_server("fed-a"), make_server("fed-b")]
+        replicas = [LocalReplica(s) for s in servers]
+        router = make_router(replicas)
+        try:
+            router.scrape_metrics_once()
+            assert set(router.status()["federated_replicas"]) == {
+                "fed-a", "fed-b",
+            }
+            replicas[0].metrics_text = lambda: None  # type: ignore
+            router.scrape_metrics_once()
+            # Stale series DROP; the healthy replica keeps federating.
+            assert router.status()["federated_replicas"] == ["fed-b"]
+        finally:
+            router.close()
+            for s in servers:
+                s.close(drain=False)
+
+
+# ---- merged fleet trace --------------------------------------------------
+
+
+def _fragment(pid: int, label: str, spans: list[tuple]) -> dict:
+    events = [
+        {
+            "ph": "M", "name": "process_name", "pid": pid,
+            "args": {"name": f"p?:{label} (pid {pid})"},
+        }
+    ]
+    for tid, name, ts_us, dur_us, args in spans:
+        events.append(
+            {
+                "ph": "X", "cat": "obs", "name": name, "ts": ts_us,
+                "dur": dur_us, "pid": pid, "tid": tid, "args": args,
+            }
+        )
+    return {"traceEvents": events, "displayTimeUnit": "ms", "otherData": {}}
+
+
+class TestMergedFleetTrace:
+    def test_merge_is_valid_chrome_schema_with_disjoint_tracks(
+        self, tmp_path
+    ):
+        frags = {
+            "trace-run1-replica-0-111.json": _fragment(
+                111, "replica-0",
+                [(1, "serve_request", 1000, 500,
+                  {"id": 0, "replica": "replica-0", "trace": "t1"})],
+            ),
+            "trace-run1-replica-1-222.json": _fragment(
+                222, "replica-1",
+                [(1, "serve_request", 1600, 400,
+                  {"id": 0, "replica": "replica-1", "trace": "t1"})],
+            ),
+        }
+        for name, doc in frags.items():
+            (tmp_path / name).write_text(json.dumps(doc))
+        out = trace.merge_traces(str(tmp_path))
+        with open(out) as f:
+            merged = json.load(f)
+        events = merged["traceEvents"]
+        assert isinstance(events, list) and events
+        for e in events:  # Chrome schema: every event has ph/name/pid
+            assert {"ph", "name", "pid"} <= set(e)
+            if e["ph"] == "X":
+                assert isinstance(e["ts"], int) and isinstance(
+                    e["dur"], int
+                )
+        tracks = {
+            rid: {
+                (e["pid"], e["tid"])
+                for e in events
+                if e.get("ph") == "X"
+                and (e.get("args") or {}).get("replica") == rid
+            }
+            for rid in ("replica-0", "replica-1")
+        }
+        assert tracks["replica-0"] and tracks["replica-1"]
+        assert not (tracks["replica-0"] & tracks["replica-1"])
+
+    def test_redispatched_request_spans_both_replicas_one_trace(
+        self, tmp_path
+    ):
+        """A shed on replica A re-dispatches to B: BOTH serve_request
+        spans carry the one trace id, the fleet_request span wraps them,
+        and the re-dispatch instant names the trace."""
+        trace.configure(str(tmp_path))
+        servers = [make_server("red-a"), make_server("red-b")]
+        replicas = [LocalReplica(s) for s in servers]
+        router = make_router(replicas)
+        try:
+            # Force replica A's admission full (shed with a recorded
+            # span) and make the pick order deterministic A-then-B.
+            full = queue.Queue(maxsize=1)
+            full.put_nowait(object())
+            servers[0]._admission = full
+            states = list(router._states)
+
+            def pick(exclude):
+                for st in states:
+                    if id(st) not in exclude and st.state == CLOSED:
+                        return st
+                return None
+
+            router._pick = pick  # type: ignore
+            dets = router.detect(
+                IMG, timeout_s=20, trace_id="t-redispatch"
+            )
+            assert dets == EXPECTED_DETECTIONS
+            events = trace.snapshot_events()
+            tagged = {
+                e["args"]["replica"]
+                for e in events
+                if e.get("ph") == "X"
+                and e.get("name") == "serve_request"
+                and (e.get("args") or {}).get("trace") == "t-redispatch"
+            }
+            assert tagged == {"red-a", "red-b"}
+            fleet_spans = [
+                e
+                for e in events
+                if e.get("ph") == "X" and e.get("name") == "fleet_request"
+            ]
+            assert any(
+                (e.get("args") or {}).get("trace") == "t-redispatch"
+                for e in fleet_spans
+            )
+            redis = [
+                e
+                for e in events
+                if e.get("ph") == "i"
+                and e.get("name") == "fleet_redispatch"
+            ]
+            assert len(redis) == 1
+            assert redis[0]["args"]["trace"] == "t-redispatch"
+            assert redis[0]["args"]["replica_id"] == "red-b"
+            # The flow chain (s → t → f) under the same id makes the hop
+            # followable in Perfetto.
+            flow_phases = {
+                e["ph"]
+                for e in events
+                if e.get("cat") == "obs.flow"
+                and e.get("id") == "t-redispatch"
+            }
+            assert {"s", "t", "f"} <= flow_phases
+        finally:
+            router.close()
+            for s in servers:
+                s.close(drain=False)
+
+
+# ---- fleet SLO -----------------------------------------------------------
+
+
+class ScriptedReplica:
+    """A replica handle with scriptable health (the test_fleet fake,
+    trimmed): 503-with-stall when unhealthy."""
+
+    version = "v1"
+
+    def __init__(self, replica_id: str):
+        self.replica_id = replica_id
+        self.healthy = True
+
+    def healthz(self):
+        if not self.healthy:
+            return 503, {"status": "stalled", "component": "serve-dispatch"}
+        return 200, {
+            "status": "ok",
+            "load": {
+                "replica_id": self.replica_id,
+                "version": self.version,
+                "inflight": 0,
+                "admission_qsize": 0,
+                "admission_capacity": 8,
+                "p99_ms": 50.0,
+                "accepting": True,
+            },
+        }
+
+    def detect(self, payload, timeout_s=None, trace_id=None):
+        return EXPECTED_DETECTIONS
+
+    def drain(self, timeout_s=5.0):
+        pass
+
+    def close(self):
+        pass
+
+
+class TestFleetSlo:
+    def test_availability_rule_fires_exactly_once_per_stall(self):
+        a, b = ScriptedReplica("slo-a"), ScriptedReplica("slo-b")
+        router = make_router([a, b])
+        mon = slo.SloMonitor(
+            router.telemetry, [slo.fleet_availability_rule()]
+        )
+        try:
+            router.poll_once(now=0.0)
+            assert mon.check_once(now=0.0) == []
+            # Injected stall: a's healthz flips 503 → breaker opens on
+            # the next poll → availability 0.5 < 0.999.
+            a.healthy = False
+            router.poll_once(now=1.0)
+            fired = mon.check_once(now=1.0)
+            assert [v["rule"] for v in fired] == ["fleet-availability"]
+            assert fired[0]["value"] == 0.5
+            # The latch: the continuing breach never re-fires.
+            for t in (2.0, 3.0, 4.0):
+                router.poll_once(now=t)
+                assert mon.check_once(now=t) == []
+            # Heal: the half-open probe readmits (backoff base 1s), the
+            # breach clears, still exactly one violation total.
+            a.healthy = True
+            router.poll_once(now=10.0)
+            assert router.status()["replicas"][0]["state"] == "closed"
+            assert mon.check_once(now=10.0) == []
+            assert len(mon.violations) == 1
+        finally:
+            mon.stop()
+            router.close()
+
+
+# ---- the fleet perf report -----------------------------------------------
+
+
+def _instant(pid, tid, name, ts_us, args):
+    return {
+        "ph": "i", "cat": "obs", "name": name, "ts": ts_us, "s": "t",
+        "pid": pid, "tid": tid, "args": args,
+    }
+
+
+class TestFleetReport:
+    def _build_obs_dir(self, d):
+        events = []
+        for pid, label in ((10, "fleet"), (11, "replica-0"),
+                           (12, "replica-1")):
+            events.append({
+                "ph": "M", "name": "process_name", "pid": pid,
+                "args": {"name": f"p?:{label} (pid {pid})"},
+            })
+        # replica-0 served t1 before dying; t2 re-dispatched onto
+        # replica-1 (spans on both tracks, one id).
+        for pid, rid, ts, tr in (
+            (11, "replica-0", 1_000_000, "t1"),
+            (11, "replica-0", 1_200_000, "t2"),
+            (12, "replica-1", 1_400_000, "t2"),
+            (12, "replica-1", 1_600_000, "t3"),
+        ):
+            events.append({
+                "ph": "X", "cat": "obs", "name": "serve_request",
+                "ts": ts, "dur": 100_000, "pid": pid, "tid": 1,
+                "args": {"id": 1, "replica": rid, "trace": tr},
+            })
+        events.append(_instant(10, 1, "fleet_replica_died", 1_300_000,
+                               {"replica_id": "replica-0", "rc": -9}))
+        events.append(_instant(10, 1, "fleet_breaker_open", 1_310_000,
+                               {"replica_id": "replica-0",
+                                "reason": "unreachable"}))
+        events.append(_instant(10, 1, "fleet_redispatch", 1_390_000,
+                               {"replica_id": "replica-1", "attempt": 1,
+                                "trace": "t2"}))
+        events.append(_instant(10, 1, "fleet_replica_respawned",
+                               1_700_000, {"replica_id": "replica-0"}))
+        events.append(_instant(10, 1, "fleet_breaker_close", 1_800_000,
+                               {"replica_id": "replica-0"}))
+        events.append(_instant(10, 1, "slo_violation", 1_320_000,
+                               {"rule": "fleet-availability",
+                                "metric": "fleet_availability",
+                                "value": 0.5, "threshold": 0.999,
+                                "sustained_s": 0.0}))
+        (d / "trace.json").write_text(json.dumps(
+            {"traceEvents": events, "otherData": {}}
+        ))
+        (d / "FLEET_METRICS.json").write_text(json.dumps({
+            "replicas": {
+                "replica-0": {"types": {}, "samples": [
+                    ["serve_requests_completed_total", {}, 2.0],
+                    ["serve_request_latency_ms", {"quantile": "0.99"},
+                     120.0],
+                ]},
+                "replica-1": {"types": {}, "samples": [
+                    ["serve_requests_completed_total", {}, 2.0],
+                    ["serve_shed_total", {"reason": "x"}, 1.0],
+                    ["serve_request_latency_ms", {"quantile": "0.99"},
+                     80.0],
+                ]},
+            },
+            "snapshot": {}, "status": {},
+        }))
+
+    def test_fleet_report_names_the_killed_replica(self, tmp_path):
+        self._build_obs_dir(tmp_path)
+        report = analyze_fleet_dir(str(tmp_path))
+        assert validate_report(report) == []
+        fleet = report["fleet"]
+        assert fleet["available"]
+        assert set(fleet["replicas"]) == {"replica-0", "replica-1"}
+        r0 = fleet["replicas"]["replica-0"]
+        assert r0["requests"] == 2
+        assert r0["federated"]["p99_ms"] == 120.0
+        shares = [
+            fleet["replicas"][r]["routing_share"]
+            for r in ("replica-0", "replica-1")
+        ]
+        assert sum(shares) == pytest.approx(1.0)
+        assert fleet["redispatched_traces"] == {
+            "count": 1, "sample": ["t2"],
+        }
+        kinds = [e["event"] for e in fleet["timeline"]]
+        assert "fleet_replica_died" in kinds
+        assert "fleet_breaker_close" in kinds
+        names = [b["name"] for b in report["bottlenecks"]]
+        # Declared SLO breach first, then the fleet verdict NAMING the
+        # killed replica, then inferred bottlenecks.
+        assert names[0] == "slo:fleet-availability"
+        assert names[1] == "fleet:unavailable_replica:replica-0"
+        ranks = [b["rank"] for b in report["bottlenecks"]]
+        assert ranks == list(range(1, len(ranks) + 1))
+
+    def test_shared_process_stage_time_is_not_multiply_attributed(self):
+        """In-process fleets share one pid across replicas: stage spans
+        (no replica arg) must NOT be credited to every replica — they
+        are skipped and flagged instead of overcounted N×."""
+        from batchai_retinanet_horovod_coco_tpu.obs.analyze.report import (
+            _fleet_section,
+        )
+
+        events = [
+            {
+                "ph": "M", "name": "process_name", "pid": 5,
+                "args": {"name": "p?:serve (pid 5)"},
+            },
+            {
+                "ph": "X", "cat": "obs", "name": "serve_dispatch",
+                "ts": 1_000_000, "dur": 50_000, "pid": 5, "tid": 1,
+                "args": {},
+            },
+        ]
+        for rid, ts in (("in-a", 1_000_000), ("in-b", 1_200_000)):
+            events.append({
+                "ph": "X", "cat": "obs", "name": "serve_request",
+                "ts": ts, "dur": 100_000, "pid": 5, "tid": 1,
+                "args": {"id": 1, "replica": rid},
+            })
+        sec = _fleet_section(events, None)
+        for rid in ("in-a", "in-b"):
+            entry = sec["replicas"][rid]
+            assert entry.get("stages_shared_process") is True
+            assert "stages_s" not in entry
+
+    def test_fleet_report_without_metrics_file_still_works(self, tmp_path):
+        self._build_obs_dir(tmp_path)
+        (tmp_path / "FLEET_METRICS.json").unlink()
+        report = analyze_fleet_dir(str(tmp_path))
+        assert validate_report(report) == []
+        assert report["source"]["fleet_metrics"] is False
+        assert report["fleet"]["replicas"]["replica-0"]["requests"] == 2
+        assert any(
+            b["name"] == "fleet:unavailable_replica:replica-0"
+            for b in report["bottlenecks"]
+        )

@@ -43,6 +43,9 @@ COLLECTIVES = frozenset({
     # the rule must see through the abstraction.
     "reduce_tree", "zero_gather_updates", "bucketed_pmean",
     "reduce_leaves", "quantized_pmean", "comm_metrics",
+    # Hierarchical tree (ISSUE 16): the per-bucket two-level reducer
+    # runs four grouped collectives internally — same see-through rule.
+    "reduce_bucket_hierarchical",
 })
 _RANKY = frozenset({
     "process_index", "process_count", "rank", "local_rank", "host_id",

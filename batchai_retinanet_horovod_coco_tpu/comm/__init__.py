@@ -26,10 +26,12 @@ from batchai_retinanet_horovod_coco_tpu.comm.config import (
 )
 from batchai_retinanet_horovod_coco_tpu.comm.compress import (
     CommPlan,
+    bucket_state_key,
     bucketed_pmean,
     comm_metrics,
     init_comm_state,
     plan_buckets,
+    reduce_bucket_hierarchical,
     reduce_tree,
     state_partition_specs,
     zero_gather_updates,
@@ -39,10 +41,12 @@ __all__ = [
     "STAGES",
     "CommConfig",
     "CommPlan",
+    "bucket_state_key",
     "bucketed_pmean",
     "comm_metrics",
     "init_comm_state",
     "plan_buckets",
+    "reduce_bucket_hierarchical",
     "reduce_tree",
     "stage_of",
     "state_partition_specs",

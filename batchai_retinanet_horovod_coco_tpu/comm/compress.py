@@ -32,7 +32,16 @@ Two collective layouts share the per-bucket quantizer:
   (summation precision untouched), EF add-back, per-block int8/bf16
   quantize of the reduced shard, compressed ``all_gather``.  Every
   device dequantizes the same gathered bytes, so the update stays
-  bitwise replicated.
+  bitwise replicated.  Handed a ``parallel.mesh.CommTopology`` the
+  tree becomes HIERARCHICAL (ISSUE 16): exact f32 reduce-scatter
+  within each ICI slice, then the quantized exchange ONLY on the
+  cross-slice DCN hop (reduce-scatter exact, gather compressed, EF
+  residual keyed per hop — ``"<stage>.<index>@dcn"``), then an exact
+  intra-slice all-gather.  Compression pays exactly where bandwidth is
+  scarce; the ICI hops carry zero quantized bytes.  When both hops
+  share one mode (or the topology is a single slice) the hierarchy
+  degenerates and callers compile the FLAT tree — byte-identical HLO,
+  pinned by tests.
 - ``zero_gather_updates`` — the ZeRO path: the gradient reduce-scatter
   stays exact per-leaf (it feeds the sharded optimizer), and
   compression moves to the OTHER half of the traffic, the
@@ -52,7 +61,8 @@ path.
 House rules: everything here is jit-pure (pure jnp + named-axis
 collectives, no clocks/IO); the collectives are unconditional — the
 collective-safety lint rule knows these wrapper names (``reduce_tree``,
-``zero_gather_updates``, ``bucketed_pmean``) as collective call sites.
+``zero_gather_updates``, ``bucketed_pmean``,
+``reduce_bucket_hierarchical``) as collective call sites.
 """
 
 from __future__ import annotations
@@ -147,7 +157,9 @@ class CommPlan:
             total += rs + gather
         return int(total)
 
-    def quant_elems(self, n: int, zero: bool = False) -> int:
+    def quant_elems(
+        self, n: int, zero: bool = False, topology=None
+    ) -> int:
         """Per-device INT8-quantized elements (the saturation
         denominator).  bf16 buckets are excluded — they can never
         saturate (no clip boundary), and counting them would dilute the
@@ -157,16 +169,92 @@ class CommPlan:
         (``zero=True``): the quantized local vector is the concat of
         PER-LEAF padded chunks, which is larger whenever leaf sizes
         don't divide ``n`` — the denominator must match or the
-        ``ef_saturation`` gauge over-reports on ZeRO runs."""
+        ``ef_saturation`` gauge over-reports on ZeRO runs.
+        Hierarchical layout (``topology``): the quantized shard is the
+        DCN-hop chunk (double-padded: first to the slice, then across
+        slices)."""
         total = 0
         for b in self.buckets:
             if b.mode != "int8":
                 continue
             if zero:
                 total += sum(self._chunk(l.size, n) for l in b.leaves)
+            elif topology is not None:
+                total += self._hier_chunk(b.size, topology)
             else:
                 total += self._chunk(b.size, n)
         return total
+
+    # ---- per-hop accounting (the hierarchical tree, ISSUE 16) ----
+
+    def _hier_chunk(self, size: int, topology) -> int:
+        """Final per-device chunk of the hierarchical tree: the bucket
+        pads to the slice count first (ICI tile), then that tile pads
+        across slices (DCN tile)."""
+        return self._chunk(
+            self._chunk(size, topology.slice_size), topology.num_slices
+        )
+
+    def _hop_bucket_bytes(self, mode: str, size: int, topology) -> dict:
+        """Per-device ring bytes of ONE bucket through the hierarchical
+        tree, split by fabric.  The tree is: ICI reduce-scatter (f32),
+        DCN reduce-scatter (f32) + gather (``mode``), ICI all-gather
+        (f32).  ``mode == "exact"`` is also the model of a flat
+        all-reduce routed hierarchically — the reference the DCN ratio
+        is stated against."""
+        S, L = topology.num_slices, topology.slice_size
+        fi = (L - 1) / max(L, 1)
+        fd = (S - 1) / max(S, 1)
+        tile = size / max(L, 1)  # the per-slice ICI tile the DCN hop moves
+        ici = fi * 4 * size * 2  # reduce-scatter + all-gather, both f32
+        dcn_rs = fd * 4 * tile
+        if mode == "int8":
+            chunk = self._hier_chunk(size, topology)
+            blocks = -(-chunk // self.config.block)
+            dcn_gather = fd * (tile + 4 * S * blocks)
+        elif mode == "bf16":
+            dcn_gather = fd * 2 * tile
+        else:
+            dcn_gather = fd * 4 * tile
+        return {"ici": ici, "dcn": dcn_rs + dcn_gather}
+
+    def hop_bytes(self, topology) -> dict:
+        """Per-device ring bytes under this plan's modes, split per
+        fabric hop: ``{"ici": ..., "dcn": ...}``.  Exact buckets route
+        hierarchically too (same tree, f32 gather) so the split is
+        comparable across modes."""
+        out = {"ici": 0.0, "dcn": 0.0}
+        for b in self.buckets:
+            bb = self._hop_bucket_bytes(b.mode, b.size, topology)
+            out["ici"] += bb["ici"]
+            out["dcn"] += bb["dcn"]
+        return {k: int(v) for k, v in out.items()}
+
+    def hop_bytes_exact(self, topology) -> dict:
+        """Per-device ring bytes of the all-exact hierarchical tree —
+        the denominator of the per-hop compression ratio."""
+        out = {"ici": 0.0, "dcn": 0.0}
+        for b in self.buckets:
+            bb = self._hop_bucket_bytes("exact", b.size, topology)
+            out["ici"] += bb["ici"]
+            out["dcn"] += bb["dcn"]
+        return {k: int(v) for k, v in out.items()}
+
+    def hop_quant_bytes(self, topology) -> dict:
+        """Per-device QUANTIZED payload bytes per hop.  The ICI hops
+        are exact f32 by construction, so ``"ici"`` is identically 0 —
+        the COMMBENCH "ICI exact" headline is this number."""
+        S = topology.num_slices
+        fd = (S - 1) / max(S, 1)
+        dcn = 0.0
+        for b in self.buckets:
+            chunk = self._hier_chunk(b.size, topology)
+            if b.mode == "int8":
+                blocks = -(-chunk // self.config.block)
+                dcn += fd * S * (chunk + 4 * blocks)
+            elif b.mode == "bf16":
+                dcn += fd * S * 2 * chunk
+        return {"ici": 0, "dcn": int(dcn)}
 
 
 def _flatten_float_leaves(tree: Any) -> list:
@@ -184,7 +272,9 @@ def _flatten_float_leaves(tree: Any) -> list:
     return out
 
 
-def plan_buckets(tree: Any, config: CommConfig) -> CommPlan:
+def plan_buckets(
+    tree: Any, config: CommConfig, topology=None
+) -> CommPlan:
     """Deterministic bucketing of a gradient/update tree.
 
     Leaves group by schedule stage (``stage_of`` on the top-level key),
@@ -194,7 +284,15 @@ def plan_buckets(tree: Any, config: CommConfig) -> CommPlan:
     state saved at world N reshards to world M with the bucket
     composition unchanged (the checkpoint-elasticity requirement).
     Non-float leaves are excluded (they take the exact per-leaf path).
+
+    With an ENGAGED hierarchical ``topology``
+    (``config.hierarchical_with``): bucket capacity comes from
+    ``dcn_bucket_mb`` (sized for the slow hop) and the bucket mode is
+    the stage's DCN mode — the only hop that compresses.  The slice
+    count does not influence composition, so the plan stays
+    world-size-independent within one policy.
     """
+    hier = config.hierarchical_with(topology)
     by_stage: dict[str, list] = {}
     for path, top, leaf in _flatten_float_leaves(tree):
         by_stage.setdefault(stage_of(top), []).append((path, leaf))
@@ -202,7 +300,7 @@ def plan_buckets(tree: Any, config: CommConfig) -> CommPlan:
     # Backward-completion order: heads first, backbone last (STAGES
     # reversed) — the order overlap issues collectives in.
     stage_order = [s for s in ("heads", "fpn", "backbone") if s in by_stage]
-    cap = config.bucket_elems
+    cap = config.dcn_bucket_elems if hier else config.bucket_elems
     for stage in stage_order:
         pending: list[BucketLeaf] = []
         total = 0
@@ -212,7 +310,9 @@ def plan_buckets(tree: Any, config: CommConfig) -> CommPlan:
             nonlocal pending, total, index
             if not pending:
                 return
-            mode = config.mode_for_stage(stage)
+            mode = config.mode_for_stage(
+                stage, config.effective_dcn_mode if hier else None
+            )
             if mode == "none":
                 # "none" (overlap-without-compression, or a per-stage
                 # opt-out) means EXACT wire format — it must never fall
@@ -254,8 +354,21 @@ def _padded_total(size: int, n: int) -> int:
     return n * (-(-size // n))
 
 
+def bucket_state_key(bucket: Bucket, topology=None) -> str:
+    """EF-state key of a bucket: ``"<stage>.<index>"`` on the flat
+    tree, ``"<stage>.<index>@dcn"`` on the hierarchical tree — the
+    residual lives on the hop that quantizes, and keying it per hop
+    keeps a policy flip (flat <-> hierarchical) an explicit layout
+    change (checkpoint ``ef_reset``) instead of a silent misread."""
+    return bucket.key if topology is None else f"{bucket.key}@dcn"
+
+
 def init_comm_state(
-    params: Any, config: CommConfig, n: int, zero: bool = False
+    params: Any,
+    config: CommConfig,
+    n: int,
+    zero: bool = False,
+    topology=None,
 ) -> dict:
     """Host-side zero EF state for ``params`` under ``config`` at world
     ``n``.  DP layout (``zero=False``): one flat ``(n * chunk,)`` f32
@@ -263,10 +376,22 @@ def init_comm_state(
     layout (``zero=True``): one flat residual per LEAF in the exact
     ZeRO storage layout (``(n * ceil(size/n),)``), keyed by the leaf's
     tree path — bucket composition then never constrains resharding.
+    Hierarchical layout (an engaged ``topology``): one flat
+    ``(n * hier_chunk,)`` residual per compressed bucket, keyed
+    ``"<stage>.<index>@dcn"`` — thanks to the interleaved mesh
+    convention (``parallel.mesh.CommTopology``) the array is in global
+    bucket order with zero padding, so ``reshard_flat_leaf`` elasticity
+    holds across world-size changes exactly like the flat layout.
     Empty dict when the policy carries no state."""
+    if zero:
+        topology = None  # the ZeRO update gather stays flat (ISSUE 16)
+    hier = config.hierarchical_with(topology)
+    if not hier:
+        config = config.flat_equivalent(topology)
+        topology = None
     if not config.needs_state:
         return {}
-    plan = plan_buckets(params, config)
+    plan = plan_buckets(params, config, topology)
     out: dict[str, np.ndarray] = {}
     for bucket in plan.buckets:
         if bucket.mode == "exact":
@@ -276,6 +401,11 @@ def init_comm_state(
                 out[leaf.path] = np.zeros(
                     (_padded_total(leaf.size, n),), np.float32
                 )
+        elif topology is not None:
+            chunk = plan._hier_chunk(bucket.size, topology)
+            out[bucket_state_key(bucket, topology)] = np.zeros(
+                (n * chunk,), np.float32
+            )
         else:
             out[bucket.key] = np.zeros(
                 (_padded_total(bucket.size, n),), np.float32
@@ -380,6 +510,79 @@ def _reduce_bucket_flat(
     return out[:size], new_res, sat
 
 
+def reduce_bucket_hierarchical(
+    flat: jnp.ndarray,
+    res: jnp.ndarray | None,
+    bucket: Bucket,
+    config: CommConfig,
+    axis_name: str,
+    topology,
+):
+    """One bucket's pmean through the two-fabric hierarchical tree
+    (call inside shard_map; ISSUE 16).
+
+    Five phases, compression ONLY on the slow hop:
+
+    1. ICI reduce-scatter (exact f32, grouped per slice): intra-slice
+       rank ``r`` owns tile ``r`` of the slice-local sum;
+    2. DCN reduce-scatter (exact f32, grouped per rank): slice ``s``
+       owns tile ``s`` of the GLOBAL sum — with the interleaved mesh
+       convention that tile is exactly ``[d * chunk, (d+1) * chunk)``
+       of the bucket flat for mesh position ``d``;
+    3. EF add-back + quantize of the owned chunk (``bucket.mode``);
+    4. DCN all-gather of the quantized payload: every device in the
+       rank group dequantizes the same bytes — the reconstructed ICI
+       tile is bitwise identical across slices;
+    5. ICI all-gather (exact f32) of the tiles back to the full bucket.
+
+    Returns (reduced full flat ``(size,)``, new local DCN-hop residual
+    or None, saturated-element count)."""
+    size = bucket.size
+    if bucket.mode == "exact":
+        return lax.pmean(flat, axis_name), res, jnp.zeros((), jnp.float32)
+    S, L = topology.num_slices, topology.slice_size
+    n = topology.num_devices
+    ici_groups = topology.ici_groups()
+    dcn_groups = topology.dcn_groups()
+    padded = _pad_flat(flat, L)
+    tile = lax.psum_scatter(
+        padded, axis_name, tiled=True, axis_index_groups=ici_groups
+    )
+    tile_padded = _pad_flat(tile, S)
+    shard = (
+        lax.psum_scatter(
+            tile_padded, axis_name, tiled=True, axis_index_groups=dcn_groups
+        )
+        / n
+    )
+    if res is not None:
+        shard = shard + res  # EF add-back: last step's dropped rounding
+    payload, deq_local, sat = _quantize_shard(
+        shard, bucket.mode, config.block
+    )
+    new_res = (shard - deq_local) if res is not None else None
+    if bucket.mode == "bf16":
+        gathered = lax.all_gather(
+            payload, axis_name, axis_index_groups=dcn_groups
+        )
+    else:
+        gathered = (
+            lax.all_gather(
+                payload[0], axis_name, axis_index_groups=dcn_groups
+            ),
+            lax.all_gather(
+                payload[1], axis_name, axis_index_groups=dcn_groups
+            ),
+        )
+    tile_out = _dequantize_gathered(
+        gathered, bucket.mode, shard.shape[0], S
+    )[: tile.shape[0]]
+    full = lax.all_gather(
+        tile_out, axis_name, tiled=True, axis_index_groups=ici_groups
+    )
+    return full[:size], new_res, sat
+
+
 # ---------------------------------------------------------------------------
 # DP path: reduce_tree (the bucketed, EF'd pmean)
 # ---------------------------------------------------------------------------
@@ -405,9 +608,13 @@ def reduce_leaves(
     config: CommConfig,
     axis_name: str,
     n: int,
+    topology=None,
 ):
     """Reduce the leaves of ``buckets`` (a leaf-path → local-grad map);
     the shared engine under ``reduce_tree`` and the overlap taps.
+    ``topology`` non-None selects the hierarchical tree (callers pass
+    it ONLY when the hierarchy actually engages — the flat fallback
+    must stay byte-identical HLO).
     Returns (reduced leaf map, new residual map, saturation count)."""
     out: dict[str, jnp.ndarray] = {}
     new_res: dict[str, jnp.ndarray] = {}
@@ -418,13 +625,19 @@ def reduce_leaves(
             g = leaf_map[leaf.path]
             parts.append(g.astype(jnp.float32).reshape(-1))
         flat = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
-        res = res_map.get(bucket.key) if bucket.mode != "exact" else None
-        reduced, res_out, sat = _reduce_bucket_flat(
-            flat, res, bucket, config, axis_name, n
-        )
+        key = bucket_state_key(bucket, topology)
+        res = res_map.get(key) if bucket.mode != "exact" else None
+        if topology is not None:
+            reduced, res_out, sat = reduce_bucket_hierarchical(
+                flat, res, bucket, config, axis_name, topology
+            )
+        else:
+            reduced, res_out, sat = _reduce_bucket_flat(
+                flat, res, bucket, config, axis_name, n
+            )
         sat_total = sat_total + sat
         if res_out is not None:
-            new_res[bucket.key] = res_out
+            new_res[key] = res_out
         for leaf in bucket.leaves:
             piece = lax.dynamic_slice(
                 reduced, (leaf.offset,), (leaf.size,)
@@ -442,15 +655,18 @@ def reduce_tree(
     config: CommConfig,
     axis_name: str = DATA_AXIS,
     n: int = 1,
+    topology=None,
 ):
     """Bucketed compressed pmean of a whole gradient tree (the fused,
     overlap-off path; call inside shard_map).  Non-float leaves take
-    the exact per-leaf pmean.  Returns (reduced tree, new comm state,
-    local saturation count)."""
+    the exact per-leaf pmean.  ``topology`` non-None selects the
+    hierarchical tree (see ``reduce_bucket_hierarchical``); callers
+    resolve the flat fallback BEFORE tracing.  Returns (reduced tree,
+    new comm state, local saturation count)."""
     leaf_map, _ = _leaf_map(grads)
     planned = {l.path for b in plan.buckets for l in b.leaves}
     out_map, new_res, sat = reduce_leaves(
-        leaf_map, comm_state, plan.buckets, config, axis_name, n
+        leaf_map, comm_state, plan.buckets, config, axis_name, n, topology
     )
     for path, leaf in leaf_map.items():
         if path not in planned:
@@ -594,21 +810,43 @@ def comm_metrics(
     axis_name: str,
     n: int,
     zero: bool = False,
+    topology=None,
 ) -> dict[str, jnp.ndarray]:
     """EF health metrics for the step's metrics dict (call inside
     shard_map, after the reduce): global residual norm, global scale
     saturation fraction, and the plan's static bytes-on-wire.
-    ``zero`` selects the ZeRO layout's saturation denominator."""
-    out: dict[str, jnp.ndarray] = {
-        "comm_compressed_bytes": jnp.asarray(
-            float(plan.compressed_bytes(n)), jnp.float32
-        ),
-    }
-    denom = float(max(1, n * plan.quant_elems(n, zero=zero)))
+    ``zero`` selects the ZeRO layout's saturation denominator.
+
+    Hierarchical runs (``topology``) split the static accounting per
+    hop — ``comm_ici_bytes`` / ``comm_dcn_bytes`` — and label the
+    residual norm with its hop (``ef_residual_norm_dcn``; all
+    hierarchical residuals live on the DCN hop) so a DCN-only blow-up
+    is attributable (the per-hop ``ef_residual_spike`` SLO rule).  The
+    hop-agnostic keys stay for dashboard continuity."""
+    if topology is not None:
+        hop = plan.hop_bytes(topology)
+        out: dict[str, jnp.ndarray] = {
+            "comm_compressed_bytes": jnp.asarray(
+                float(hop["ici"] + hop["dcn"]), jnp.float32
+            ),
+            "comm_ici_bytes": jnp.asarray(float(hop["ici"]), jnp.float32),
+            "comm_dcn_bytes": jnp.asarray(float(hop["dcn"]), jnp.float32),
+        }
+    else:
+        out = {
+            "comm_compressed_bytes": jnp.asarray(
+                float(plan.compressed_bytes(n)), jnp.float32
+            ),
+        }
+    denom = float(
+        max(1, n * plan.quant_elems(n, zero=zero, topology=topology))
+    )
     out["ef_saturation"] = lax.psum(sat_local, axis_name) / denom
     if new_comm_state:
         sq = sum(
             jnp.sum(jnp.square(r)) for r in new_comm_state.values()
         )
         out["ef_residual_norm"] = jnp.sqrt(lax.psum(sq, axis_name))
+        if topology is not None:
+            out["ef_residual_norm_dcn"] = out["ef_residual_norm"]
     return out

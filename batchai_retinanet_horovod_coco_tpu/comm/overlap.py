@@ -44,6 +44,7 @@ from jax import lax
 
 from batchai_retinanet_horovod_coco_tpu.comm.compress import (
     CommPlan,
+    bucket_state_key,
     reduce_leaves,
 )
 from batchai_retinanet_horovod_coco_tpu.comm.config import (
@@ -99,12 +100,16 @@ def make_stage_tap(
     axis_name: str,
     n: int,
     raw_root: bool,
+    topology=None,
 ) -> Callable:
     """Identity on a stage's params whose VJP reduces the cotangents.
 
     ``tap(params_sub, res_sub, token) -> params_sub``; under ``grad``
     the cotangents are (reduced grads, new EF residuals, saturation
-    count) — see the module docstring's cotangent-channel contract."""
+    count) — see the module docstring's cotangent-channel contract.
+    ``topology`` non-None stages the HIERARCHICAL reduce (exact ICI,
+    compressed DCN) instead of the flat one — same shared engine
+    (``reduce_leaves``), so overlap-on/off parity holds per hop too."""
     buckets = plan.stage_buckets(stage)
     bucket_paths = {l.path for b in buckets for l in b.leaves}
 
@@ -120,7 +125,7 @@ def make_stage_tap(
     def bwd(res_sub, ct):
         leaf_map = _stage_leaf_map(ct, raw_root)
         out_map, new_res, sat = reduce_leaves(
-            leaf_map, res_sub, buckets, config, axis_name, n
+            leaf_map, res_sub, buckets, config, axis_name, n, topology
         )
         # Non-bucketed leaves of this stage (non-float) reduce exact.
         for path, leaf in leaf_map.items():
@@ -137,23 +142,30 @@ def make_stage_tap(
 
 
 def make_overlap_grad_fn(
-    plan: CommPlan, config: CommConfig, axis_name: str, n: int
+    plan: CommPlan, config: CommConfig, axis_name: str, n: int,
+    topology=None,
 ) -> Callable:
     """Build ``grad_fn(loss_of_params, params, comm_state)`` returning
     ``((loss, aux), reduced_grads, new_comm_state, sat_count)`` with the
-    per-stage collectives staged inside the backward pass."""
+    per-stage collectives staged inside the backward pass.  With
+    ``topology`` each stage's collective is the hierarchical tree and
+    the EF residuals use the per-hop keys (``bucket_state_key``)."""
     def grad_fn(loss_of_params, params, comm_state):
         raw_root = not isinstance(params, Mapping)
         groups = group_tree(params, plan)
         taps = {
-            s: make_stage_tap(s, plan, config, axis_name, n, raw_root)
+            s: make_stage_tap(
+                s, plan, config, axis_name, n, raw_root, topology
+            )
             for s in groups
         }
         res_groups = {
             s: {
-                b.key: comm_state[b.key]
+                bucket_state_key(b, topology): comm_state[
+                    bucket_state_key(b, topology)
+                ]
                 for b in plan.stage_buckets(s)
-                if b.key in comm_state
+                if bucket_state_key(b, topology) in comm_state
             }
             for s in groups
         }

@@ -85,6 +85,13 @@ GROUP_PREFIX = "gnorm/"
 EF_RESIDUAL = "ef_residual_norm"
 EF_SATURATION = "ef_saturation"
 COMM_BYTES = "comm_compressed_bytes"
+#: Per-hop keys (ISSUE 16): present only on hierarchical-topology runs.
+#: The ICI/DCN byte split is the static wire accounting per fabric; the
+#: DCN-labeled residual norm makes a cross-slice EF blow-up attributable
+#: (the per-hop ef_residual_spike rule watches its gauge).
+EF_RESIDUAL_DCN = "ef_residual_norm_dcn"
+COMM_ICI_BYTES = "comm_ici_bytes"
+COMM_DCN_BYTES = "comm_dcn_bytes"
 
 #: Scalars whose non-finiteness the provenance pass attributes first, in
 #: root-cause order (a NaN cls_loss names the classification path even

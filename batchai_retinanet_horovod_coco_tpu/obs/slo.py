@@ -496,6 +496,7 @@ def ef_residual_spike(
     window: int = 32,
     metric: str = "train_ef_residual",
     for_s: float = 0.0,
+    hop: str | None = None,
 ) -> SloRule:
     """Gradient-compression health tripwire (ISSUE 13): the error-
     feedback residual norm vs ``factor ×`` its own rolling-median
@@ -506,9 +507,17 @@ def ef_residual_spike(
     mode, like ``grad_norm_spike``: no absolute ceiling to hand-pick,
     and the rule stays silent on runs without compression (the
     ``train_ef_residual`` gauge never exists), so it is ALWAYS armed in
-    train.py's built-in rule set."""
+    train.py's built-in rule set.
+
+    ``hop`` labels the rule per fabric hop of the hierarchical tree
+    (ISSUE 16): ``hop="dcn"`` watches the ``train_ef_residual_dcn``
+    gauge — the cross-slice hop, the only one that quantizes — under
+    the name ``ef_residual_spike_dcn``.  Same silent-without-the-gauge
+    contract, so the hop variant is armed unconditionally too."""
+    if hop is not None:
+        metric = f"train_ef_residual_{hop}"
     return SloRule(
-        name="ef_residual_spike",
+        name="ef_residual_spike" if hop is None else f"ef_residual_spike_{hop}",
         metric=metric,
         op=">",
         baseline_window=window,
@@ -518,6 +527,7 @@ def ef_residual_spike(
             f"gradient-compression EF residual above {factor}x its "
             "rolling-median baseline (per-block scales saturating; "
             "compressed gradients dropping signal)"
+            + (f" [{hop} hop of the hierarchical tree]" if hop else "")
         ),
     )
 

@@ -791,6 +791,30 @@ def _train_handles() -> dict[str, Any]:
                 "cumulative compressed gradient bytes-on-wire "
                 "(per-device ring estimate, comm/compress plan)",
             ),
+            # Per-hop wire accounting (ISSUE 16, hierarchical tree):
+            # the DCN counter is the scarce-fabric spend the headline
+            # ratio is stated against; ICI stays exact (f32) but its
+            # bytes are counted so the split always sums to the total.
+            # The DCN-labeled residual gauge is what the per-hop
+            # ef_residual_spike rule (hop="dcn") evaluates.
+            "comm_ici_bytes": r.counter(
+                "train_comm_ici_bytes_total",
+                "cumulative gradient bytes-on-wire over the fast "
+                "intra-slice (ICI) hops of the hierarchical tree "
+                "(exact f32 by construction)",
+            ),
+            "comm_dcn_bytes": r.counter(
+                "train_comm_dcn_bytes_total",
+                "cumulative gradient bytes-on-wire over the slow "
+                "cross-slice (DCN) hop of the hierarchical tree "
+                "(the compressed exchange)",
+            ),
+            "ef_residual_dcn": r.gauge(
+                "train_ef_residual_dcn",
+                "global L2 norm of the DCN-hop error-feedback "
+                "residual (hierarchical tree; the only hop that "
+                "quantizes)",
+            ),
         }
     return _train_gauges
 
@@ -857,13 +881,18 @@ def record_comm(
     ef_residual: float | None = None,
     ef_saturation: float | None = None,
     compressed_bytes: float | None = None,
+    ici_bytes: float | None = None,
+    dcn_bytes: float | None = None,
+    ef_residual_dcn: float | None = None,
     steps: int = 1,
 ) -> None:
-    """The train loop's comm/EF record site (ISSUE 13; per log window).
-    One bool check while telemetry is off; absent fields (compression
-    off, EF off) are skipped.  ``compressed_bytes`` is the plan's
-    static per-step figure — the counter accumulates it over the
-    window's ``steps``."""
+    """The train loop's comm/EF record site (ISSUE 13/16; per log
+    window).  One bool check while telemetry is off; absent fields
+    (compression off, EF off, flat tree) are skipped.  The byte figures
+    are the plan's static per-step numbers — the counters accumulate
+    them over the window's ``steps``.  ``ici_bytes`` / ``dcn_bytes`` /
+    ``ef_residual_dcn`` exist only on hierarchical-topology runs
+    (per-hop accounting)."""
     if not _enabled:
         return
     g = _train_handles()
@@ -873,6 +902,12 @@ def record_comm(
         g["ef_saturation"].set(float(ef_saturation))
     if compressed_bytes is not None and math.isfinite(compressed_bytes):
         g["comm_bytes"].inc(float(compressed_bytes) * max(1, int(steps)))
+    if ici_bytes is not None and math.isfinite(ici_bytes):
+        g["comm_ici_bytes"].inc(float(ici_bytes) * max(1, int(steps)))
+    if dcn_bytes is not None and math.isfinite(dcn_bytes):
+        g["comm_dcn_bytes"].inc(float(dcn_bytes) * max(1, int(steps)))
+    if ef_residual_dcn is not None and math.isfinite(ef_residual_dcn):
+        g["ef_residual_dcn"].set(float(ef_residual_dcn))
 
 
 _serve_metrics: dict[str, Any] | None = None

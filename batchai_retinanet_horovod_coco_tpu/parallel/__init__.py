@@ -17,7 +17,9 @@ backend H3, MPI control plane H4):
 
 from batchai_retinanet_horovod_coco_tpu.parallel.mesh import (
     DATA_AXIS,
+    CommTopology,
     batch_sharding,
+    derive_topology,
     make_mesh,
     replicated_sharding,
 )
@@ -30,8 +32,10 @@ from batchai_retinanet_horovod_coco_tpu.parallel.zero import (
 
 __all__ = [
     "DATA_AXIS",
+    "CommTopology",
     "batch_sharding",
     "clip_by_global_norm_sharded",
+    "derive_topology",
     "init_sharded_opt_state",
     "make_mesh",
     "opt_state_partition_specs",

@@ -14,6 +14,9 @@ Multi-host: ``jax.devices()`` returns the GLOBAL device list after
 
 from __future__ import annotations
 
+import dataclasses
+import os
+
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
@@ -24,9 +27,144 @@ DATA_AXIS = "data"
 # evaluate.detect.make_detect_fn_spatial).
 SPACE_AXIS = "space"
 
+#: Env override for the slice count (same knob as ``--comm-slices``):
+#: lets the 8-device virtual CPU mesh emulate e.g. 2 slices x 4 devices.
+COMM_SLICES_ENV = "RETINANET_COMM_SLICES"
 
-def make_mesh(num_devices: int | None = None) -> Mesh:
-    """1-D data-parallel mesh over the first ``num_devices`` global devices."""
+
+@dataclasses.dataclass(frozen=True)
+class CommTopology:
+    """Two-level device grouping for hierarchical collectives (ISSUE 16).
+
+    A pod is two fabrics: fast ICI within a slice, slow DCN across
+    slices.  ``num_slices`` (S) counts slices, ``slice_size`` (L) the
+    devices per slice; the mesh's 1-D data axis holds ``S * L`` devices.
+
+    Mesh-position convention — INTERLEAVED: position ``d`` on the data
+    axis belongs to slice ``d % S`` with intra-slice rank ``d // S``.
+    This is deliberate, not cosmetic: after the hierarchical tree's two
+    reduce-scatters (ICI tile by rank, then DCN tile by slice), the
+    final shard of position ``d`` covers global flat elements
+    ``[d * chunk, (d + 1) * chunk)`` — so the per-hop EF residual
+    arrays, sharded ``P(DATA_AXIS)`` in position order, stay in GLOBAL
+    BUCKET ORDER (logical prefix + zero padding), which is exactly the
+    invariant ``parallel.zero.reshard_flat_leaf`` needs for checkpoint
+    elasticity across world-size changes.  ``arrange_devices`` orders
+    real slice-indexed devices to match.
+    """
+
+    num_slices: int
+    slice_size: int
+
+    def __post_init__(self):
+        if self.num_slices < 1 or self.slice_size < 1:
+            raise ValueError(
+                f"CommTopology needs num_slices >= 1 and slice_size >= 1, "
+                f"got {self.num_slices} x {self.slice_size}"
+            )
+
+    @property
+    def num_devices(self) -> int:
+        return self.num_slices * self.slice_size
+
+    def ici_groups(self) -> list:
+        """Mesh positions grouped by slice (the fast-fabric groups):
+        group ``s`` lists slice ``s``'s members in intra-slice rank
+        order — the order grouped ``psum_scatter`` tiles by."""
+        S, L = self.num_slices, self.slice_size
+        return [[r * S + s for r in range(L)] for s in range(S)]
+
+    def dcn_groups(self) -> list:
+        """Mesh positions grouped by intra-slice rank (the slow-fabric
+        groups): group ``r`` lists rank ``r``'s device on every slice,
+        in slice order."""
+        S, L = self.num_slices, self.slice_size
+        return [[r * S + s for s in range(S)] for r in range(L)]
+
+
+def derive_topology(
+    num_devices: int, num_slices: int | None = None
+) -> CommTopology | None:
+    """CommTopology for a ``num_devices``-wide data axis, or None (flat).
+
+    Slice count resolution, highest priority first: the explicit
+    ``num_slices`` argument (the ``--comm-slices`` CLI knob), the
+    ``RETINANET_COMM_SLICES`` env var, then the devices' own
+    ``slice_index`` attribute (real multi-slice TPU).  CPU/GPU devices
+    carry no slice_index, so the virtual mesh is flat unless the
+    override says otherwise — that override is how the 8-device CPU
+    mesh emulates 2 slices x 4 devices."""
+    if num_slices is None:
+        env = os.environ.get(COMM_SLICES_ENV, "").strip()
+        if env:
+            try:
+                num_slices = int(env)
+            except ValueError:
+                raise ValueError(
+                    f"{COMM_SLICES_ENV} must be an integer slice count, "
+                    f"got {env!r}"
+                ) from None
+    if num_slices is None:
+        indices = [
+            getattr(d, "slice_index", None)
+            for d in jax.devices()[:num_devices]
+        ]
+        distinct = {i for i in indices if i is not None}
+        if len(distinct) <= 1 or None in indices:
+            return None
+        num_slices = len(distinct)
+    if num_slices < 1:
+        raise ValueError(f"comm slices must be >= 1, got {num_slices}")
+    if num_devices % num_slices:
+        raise ValueError(
+            f"{num_devices} devices do not divide into {num_slices} "
+            f"equal slices — pick a slice count dividing the data-axis "
+            "width"
+        )
+    return CommTopology(
+        num_slices=num_slices, slice_size=num_devices // num_slices
+    )
+
+
+def arrange_devices(devices, topology: CommTopology):
+    """Order ``devices`` for ``topology``'s interleaved mesh convention.
+
+    Devices with a real ``slice_index`` are grouped by slice and dealt
+    round-robin so mesh position ``d`` lands on slice ``d % S`` (see
+    CommTopology).  Devices without slice info (the virtual CPU mesh)
+    keep their order — positions EMULATE slices there, which is the
+    point of the override."""
+    indices = [getattr(d, "slice_index", None) for d in devices]
+    distinct = sorted({i for i in indices if i is not None})
+    if len(distinct) != topology.num_slices:
+        return list(devices)
+    by_slice = {s: [] for s in distinct}
+    for d, i in zip(devices, indices):
+        by_slice[i].append(d)
+    if any(
+        len(members) != topology.slice_size for members in by_slice.values()
+    ):
+        raise ValueError(
+            f"device slices are unequal "
+            f"({[len(v) for v in by_slice.values()]} members) — "
+            f"cannot arrange a {topology.num_slices}x"
+            f"{topology.slice_size} topology"
+        )
+    out = []
+    for r in range(topology.slice_size):
+        for s in distinct:
+            out.append(by_slice[s][r])
+    return out
+
+
+def make_mesh(
+    num_devices: int | None = None,
+    topology: CommTopology | None = None,
+) -> Mesh:
+    """1-D data-parallel mesh over the first ``num_devices`` global devices.
+
+    With ``topology``: devices are ordered for the hierarchical
+    collectives' interleaved slice convention (``arrange_devices``)."""
     devices = jax.devices()
     if num_devices is not None:
         if num_devices > len(devices):
@@ -34,6 +172,13 @@ def make_mesh(num_devices: int | None = None) -> Mesh:
                 f"requested {num_devices} devices, have {len(devices)}"
             )
         devices = devices[:num_devices]
+    if topology is not None:
+        if topology.num_devices != len(devices):
+            raise ValueError(
+                f"topology is {topology.num_slices}x{topology.slice_size} "
+                f"= {topology.num_devices} devices, mesh has {len(devices)}"
+            )
+        devices = arrange_devices(devices, topology)
     return Mesh(np.asarray(devices), axis_names=(DATA_AXIS,))
 
 

@@ -468,6 +468,7 @@ def run_training(
     shard_weight_update: bool = False,
     quantized_allreduce: bool = False,
     comm=None,
+    topology=None,
     allow_data_axis_divergence: bool = False,
 ) -> TrainState:
     """Run ``config.total_steps`` of SPMD training; returns the final state.
@@ -481,6 +482,9 @@ def run_training(
     feedback, optional backward overlap; composes with
     ``shard_weight_update`` (the compression moves to the ZeRO update
     gather).  ``quantized_allreduce`` is the deprecated bool alias.
+    ``topology`` (a ``parallel.mesh.CommTopology``, ISSUE 16) makes the
+    comm collective hierarchical — exact within each ICI slice,
+    compressed only on the cross-slice DCN hop (train/step.py).
 
     A 2-D mesh carrying a ``space`` axis selects the spatially partitioned
     step (image-H sharding; train/step.py::make_train_step_spatial) —
@@ -705,6 +709,7 @@ def run_training(
                             shard_weight_update=shard_weight_update,
                             quantized_allreduce=quantized_allreduce,
                             comm=comm,
+                            topology=topology,
                             numerics=numerics_config,
                         )
                     # No process may enter the step's collectives while a
@@ -863,6 +868,15 @@ def run_training(
                     ef_residual=scalars.get(numerics_lib.EF_RESIDUAL),
                     ef_saturation=scalars.get(numerics_lib.EF_SATURATION),
                     compressed_bytes=scalars.get(numerics_lib.COMM_BYTES),
+                    # Per-hop plane (ISSUE 16): present only on
+                    # hierarchical runs — ICI/DCN byte counters plus the
+                    # DCN-labeled residual gauge the per-hop
+                    # ef_residual_spike rule watches.
+                    ici_bytes=scalars.get(numerics_lib.COMM_ICI_BYTES),
+                    dcn_bytes=scalars.get(numerics_lib.COMM_DCN_BYTES),
+                    ef_residual_dcn=scalars.get(
+                        numerics_lib.EF_RESIDUAL_DCN
+                    ),
                     steps=window_steps,
                 )
                 if config.numerics:

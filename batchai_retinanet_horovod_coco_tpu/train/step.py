@@ -260,6 +260,7 @@ def make_train_step(
     shard_weight_update: bool = False,
     quantized_allreduce: bool = False,
     comm=None,
+    topology=None,
     numerics: NumericsConfig | None = None,
 ) -> Callable[[TrainState, dict[str, Any]], tuple[TrainState, dict[str, jnp.ndarray]]]:
     """Build the jitted train step for one shape bucket.
@@ -296,6 +297,23 @@ def make_train_step(
     (or ``compress="none"``) the compiled step is byte-identical to the
     pre-ISSUE-13 program.
 
+    ``topology`` (a ``parallel.mesh.CommTopology``; ISSUE 16): the
+    two-level slice x intra-slice device grouping.  When it names more
+    than one slice AND ``comm``'s per-hop modes differ, the gradient
+    collective becomes the HIERARCHICAL tree — exact f32
+    reduce-scatter within each ICI slice, quantized exchange only on
+    the cross-slice DCN hop, exact intra-slice all-gather — with the
+    EF residuals keyed per hop and the wire accounting split into
+    ``comm_ici_bytes`` / ``comm_dcn_bytes``.  Otherwise the hierarchy
+    degenerates and the step compiles the FLAT tree at the effective
+    single-hop mode, byte-identical to passing no topology at all
+    (single-slice worlds run the whole tree at ``ici_mode``, i.e.
+    exact by default — there is no slow wire to compress).  The mesh
+    must be built with the same topology (``make_mesh(..., topology)``)
+    so slice-index devices sit in the interleaved order the groups
+    assume.  ZeRO runs ignore the topology (the update gather stays
+    flat) with a structured warning.
+
     ``quantized_allreduce``: DEPRECATED alias for
     ``comm=CommConfig(compress="int8")`` (stateless unless the state
     carries EF residuals) — the pre-ISSUE-13 per-leaf path is gone.
@@ -321,9 +339,37 @@ def make_train_step(
         from batchai_retinanet_horovod_coco_tpu.comm import CommConfig
 
         comm = CommConfig(compress="int8")
+    # Hop-policy resolution (ISSUE 16): the hierarchical tree engages
+    # only for a real multi-slice topology with distinct per-hop modes;
+    # every other case resolves to the flat tree BEFORE tracing so the
+    # degenerate paths compile byte-identical HLO.
+    comm_topology = None
+    if comm is not None and topology is not None:
+        if shard_weight_update:
+            if comm.hierarchical_with(topology):
+                import warnings
+
+                warnings.warn(
+                    "comm topology has no effect with "
+                    "shard_weight_update: the ZeRO path compresses the "
+                    "post-update gather, which stays flat — the "
+                    "hierarchical tree is a DP-path mechanism"
+                )
+        elif comm.hierarchical_with(topology):
+            comm_topology = topology
+        else:
+            comm = comm.flat_equivalent(topology)
     comm_on = comm is not None and comm.enabled
     if comm_on and mesh is None:
         raise ValueError("comm compression requires a mesh")
+    if comm_topology is not None and mesh is not None:
+        if comm_topology.num_devices != mesh.size:
+            raise ValueError(
+                f"topology is {comm_topology.num_slices}x"
+                f"{comm_topology.slice_size} = "
+                f"{comm_topology.num_devices} devices but the mesh has "
+                f"{mesh.size}"
+            )
     if comm_on and comm.overlap and shard_weight_update:
         # The ZeRO flavor's compressed collective is the POST-update
         # gather — there is no backward-stage collective for overlap to
@@ -512,7 +558,9 @@ def make_train_step(
         )
 
         def make_comm_step(state_template: TrainState):
-            plan = compress_lib.plan_buckets(state_template.params, comm)
+            plan = compress_lib.plan_buckets(
+                state_template.params, comm, comm_topology
+            )
             spec = TrainState(
                 step=P(),
                 params=jax.tree.map(lambda _: P(), state_template.params),
@@ -529,7 +577,7 @@ def make_train_step(
             )
             grad_fn = (
                 overlap_lib.make_overlap_grad_fn(
-                    plan, comm, DATA_AXIS, mesh.size
+                    plan, comm, DATA_AXIS, mesh.size, comm_topology
                 )
                 if comm.overlap
                 else None
@@ -579,7 +627,8 @@ def make_train_step(
                     # One fused pass: exact f32 reduce-scatter + EF
                     # add-back + compressed gather per bucket.
                     grads, new_comm, sat = compress_lib.reduce_tree(
-                        grads, comm_cs, plan, comm, DATA_AXIS, mesh.size
+                        grads, comm_cs, plan, comm, DATA_AXIS, mesh.size,
+                        comm_topology,
                     )
                 num_pos = lax.psum(metrics["num_pos"], DATA_AXIS)
                 metrics = lax.pmean(metrics, DATA_AXIS)
@@ -598,7 +647,8 @@ def make_train_step(
                 metrics["param_norm"] = optax.global_norm(new_state.params)
                 metrics.update(
                     compress_lib.comm_metrics(
-                        plan, new_comm, sat, DATA_AXIS, mesh.size
+                        plan, new_comm, sat, DATA_AXIS, mesh.size,
+                        topology=comm_topology,
                     )
                 )
                 if isinstance(state.comm_state, dict):

@@ -121,6 +121,42 @@ def add_comm_flags(parser) -> None:
                              "(comm state): quantization error is then "
                              "dropped each step instead of carried — "
                              "debugging/ablation only")
+    # Topology-aware hierarchical tree (ISSUE 16): per-hop policy +
+    # the slice-count knob that activates it.
+    parser.add_argument("--comm-slices", type=int, default=None,
+                        metavar="N",
+                        help="slice count of the two-level device "
+                             "grouping (parallel/mesh.py CommTopology): "
+                             "with N > 1 and distinct per-hop modes the "
+                             "gradient collective becomes hierarchical "
+                             "— exact f32 within each ICI slice, "
+                             "compressed exchange only on the "
+                             "cross-slice DCN hop.  Default: derived "
+                             "from the devices' slice_index (real "
+                             "multi-slice TPU) or the "
+                             "RETINANET_COMM_SLICES env; on the "
+                             "virtual CPU mesh pass e.g. 2 to emulate "
+                             "2 slices x 4 devices")
+    parser.add_argument("--comm-ici-mode", default=None,
+                        choices=["none", "int8", "bf16"],
+                        help="wire format of the intra-slice (ICI) "
+                             "hops once a topology engages; default "
+                             "none = the fast wire stays exact f32.  "
+                             "A compressed ici mode must equal the dcn "
+                             "mode (which is just the flat tree)")
+    parser.add_argument("--comm-dcn-mode", default=None,
+                        choices=["none", "int8", "bf16"],
+                        help="wire format of the cross-slice (DCN) hop "
+                             "once a topology engages; default: "
+                             "inherit --comm-compress — so "
+                             "'--comm-compress int8 --comm-slices 2' "
+                             "alone gives exact-ICI / int8-DCN")
+    parser.add_argument("--comm-dcn-bucket-mb", type=float, default=None,
+                        metavar="MB",
+                        help="bucket capacity for the hierarchical "
+                             "plan, sized for the DCN hop (the wire "
+                             "that actually hurts); default: inherit "
+                             "--comm-bucket-mb")
 
 
 def make_comm_config(args):
@@ -152,13 +188,26 @@ def make_comm_config(args):
             file=_sys.stderr, flush=True,
         )
     overlap = bool(getattr(args, "comm_overlap", False))
-    if compress == "none" and not overlap:
+    ici_mode = getattr(args, "comm_ici_mode", None)
+    dcn_mode = getattr(args, "comm_dcn_mode", None)
+    dcn_bucket_mb = getattr(args, "comm_dcn_bucket_mb", None)
+    if (
+        compress == "none"
+        and not overlap
+        and (dcn_mode or "none") == "none"
+        and (ici_mode or "none") == "none"
+    ):
         return None
     return CommConfig(
         compress=compress,
         overlap=overlap,
         bucket_mb=float(getattr(args, "comm_bucket_mb", 4.0)),
         error_feedback=not getattr(args, "comm_no_error_feedback", False),
+        ici_mode=ici_mode,
+        dcn_mode=dcn_mode,
+        dcn_bucket_mb=(
+            None if dcn_bucket_mb is None else float(dcn_bucket_mb)
+        ),
     )
 
 

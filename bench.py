@@ -46,10 +46,14 @@ bytes-on-wire vs the exact schedule (the ROADMAP's ≤ 0.65× claim, from
 the plan arithmetic — device-independent), step-time delta per variant
 (int8 / int8+overlap / bf16 / 1 MB buckets; indicative only on the
 virtual mesh), and loss/param parity drift after N identical steps vs
-the exact run.  The committed record is COMMBENCH.json (written by
-``scripts/commbench_sweep.py`` / COMMBENCH_OUT); ``make
-commbench-check`` is the tripwire (bytes ratio hard ≤ 0.65 AND ≤
-committed + 0.02, parity-drift band, device-class guard).
+the exact run.  The hierarchical leg (ISSUE 16) routes the int8 policy
+through the two-fabric tree on an emulated 2-slice topology and records
+the per-hop split: DCN bytes ≤ 0.65× the all-exact hierarchical tree,
+ZERO quantized ICI bytes, drift in the flat band.  The committed record
+is COMMBENCH.json (written by ``scripts/commbench_sweep.py`` /
+COMMBENCH_OUT); ``make commbench-check`` is the tripwire (bytes ratio
+hard ≤ 0.65 AND ≤ committed + 0.02, the per-hop claims, parity-drift
+band, device-class guard).
 
 ``vs_baseline``: the reference's own throughput was never recorded
 (BASELINE.json "published": {}, see BASELINE.md), so the ratio is computed
@@ -996,7 +1000,9 @@ def _comm_timed_steps(step_fn, state, batch, steps: int) -> float:
     return (time.perf_counter() - t0) / max(1, steps)
 
 
-def _comm_run_variant(model, state, mesh, n, batch, comm_cfg, steps):
+def _comm_run_variant(
+    model, state, mesh, n, batch, comm_cfg, steps, topology=None
+):
     """(timed s/step, final state after COMM_PARITY_STEPS, losses)."""
     from batchai_retinanet_horovod_coco_tpu.comm import init_comm_state
     from batchai_retinanet_horovod_coco_tpu.train import make_train_step
@@ -1005,11 +1011,14 @@ def _comm_run_variant(model, state, mesh, n, batch, comm_cfg, steps):
     if comm_cfg is not None and comm_cfg.needs_state:
         st = st.replace(
             comm_state=jax.device_put(
-                init_comm_state(state.params, comm_cfg, n)
+                init_comm_state(
+                    state.params, comm_cfg, n, topology=topology
+                )
             )
         )
     step_fn = make_train_step(
-        model, (64, 64), 80, mesh=mesh, comm=comm_cfg, donate_state=False
+        model, (64, 64), 80, mesh=mesh, comm=comm_cfg, topology=topology,
+        donate_state=False,
     )
     s_per_step = _comm_timed_steps(step_fn, st, batch, steps)
     losses = []
@@ -1084,6 +1093,54 @@ def run_comm_record(sweep: bool) -> dict:
                 _param_rel_drift(v_state.params, exact_state.params), 6
             ),
             "buckets": len(plan.buckets),
+        }
+
+    # Hierarchical leg (ISSUE 16): the same int8 policy routed through
+    # the two-fabric tree on an EMULATED 2-slice topology (the virtual
+    # CPU mesh playing S slices x L devices) — exact f32 within each
+    # slice, quantization only on the cross-slice DCN hop.  Always
+    # measured (not sweep-gated): commbench-check enforces the per-hop
+    # claims.  Needs an even mesh; a deliberately odd COMMBENCH_DEVICES
+    # records the skip instead of faking a topology.
+    if n % 2 == 0 and n >= 4:
+        from batchai_retinanet_horovod_coco_tpu.parallel import CommTopology
+
+        topo = CommTopology(num_slices=2, slice_size=n // 2)
+        hier_cfg = CommConfig(compress="int8")  # ici exact, dcn int8
+        assert hier_cfg.hierarchical_with(topo)
+        hier_mesh = make_mesh(n, topology=topo)
+        hplan = plan_buckets(state.params, hier_cfg, topo)
+        h_s, h_state, h_losses = _comm_run_variant(
+            model, state, hier_mesh, n, batch, hier_cfg, steps,
+            topology=topo,
+        )
+        hop = hplan.hop_bytes(topo)
+        hop_exact = hplan.hop_bytes_exact(topo)
+        hop_quant = hplan.hop_quant_bytes(topo)
+        per_variant["hier_int8_dcn"] = {
+            "topology": f"{topo.num_slices}x{topo.slice_size}",
+            "hop_bytes": hop,
+            "hop_bytes_exact": hop_exact,
+            "hop_quant_bytes": hop_quant,
+            # Headline per-hop claims: the DCN hop's bytes vs the
+            # all-exact hierarchical tree, and zero quantized ICI bytes.
+            "dcn_bytes_ratio": round(
+                hop["dcn"] / max(1, hop_exact["dcn"]), 4
+            ),
+            "ici_quant_bytes": hop_quant["ici"],
+            "s_per_step": round(h_s, 4),
+            "step_time_delta_pct": round(
+                (h_s - exact_s) / max(exact_s, 1e-9) * 100, 2
+            ),
+            "loss_drift_at_n": round(
+                abs(h_losses[-1] - exact_losses[-1])
+                / max(abs(exact_losses[-1]), 1e-9),
+                6,
+            ),
+            "param_rel_drift_at_n": round(
+                _param_rel_drift(h_state.params, exact_state.params), 6
+            ),
+            "buckets": len(hplan.buckets),
         }
     flag = per_variant["int8"]
     return {
@@ -1160,10 +1217,54 @@ def check_comm_against_committed(record: dict) -> int:
             f"(3x committed {committed_drift}, floor 2e-2): REGRESSION"
         )
         rc = 1
+    # Hierarchical leg (ISSUE 16): the per-hop claims — the DCN hop's
+    # compressed bytes hold <= 0.65x the all-exact hierarchical tree,
+    # the ICI hops carry ZERO quantized bytes, and the parity drift vs
+    # the exact flat tree stays in the same band as the flat variant.
+    hier = record["per_variant"].get("hier_int8_dcn")
+    if hier is None:
+        print(
+            "# commbench-check: no hierarchical leg in this run "
+            "(odd COMMBENCH_DEVICES?) — per-hop claims unchecked: "
+            "REGRESSION"
+        )
+        rc = 1
+    else:
+        dcn_ratio = float(hier["dcn_bytes_ratio"])
+        if dcn_ratio > 0.65:
+            print(
+                f"# commbench-check: DCN bytes ratio {dcn_ratio} > 0.65 "
+                "— the per-hop compression claim no longer holds: "
+                "REGRESSION"
+            )
+            rc = 1
+        if int(hier["ici_quant_bytes"]) != 0:
+            print(
+                f"# commbench-check: ICI hops carry "
+                f"{hier['ici_quant_bytes']} quantized bytes (must be 0 "
+                "— the fast wire stays exact): REGRESSION"
+            )
+            rc = 1
+        committed_hier_drift = float(
+            committed.get("per_variant", {}).get("hier_int8_dcn", {}).get(
+                "param_rel_drift_at_n", 0.0
+            )
+        )
+        hier_drift = float(hier["param_rel_drift_at_n"])
+        hier_ceiling = max(3 * committed_hier_drift, 2e-2)
+        if hier_drift > hier_ceiling:
+            print(
+                f"# commbench-check: hierarchical parity drift "
+                f"{hier_drift} > {hier_ceiling} (3x committed "
+                f"{committed_hier_drift}, floor 2e-2): REGRESSION"
+            )
+            rc = 1
     if rc == 0:
         print(
             f"# commbench-check: bytes ratio {ratio} <= 0.65 (committed "
-            f"{committed_ratio}), parity drift {drift} <= {ceiling}: ok"
+            f"{committed_ratio}), parity drift {drift} <= {ceiling}, "
+            f"DCN ratio {hier['dcn_bytes_ratio']} <= 0.65 with 0 "
+            "quantized ICI bytes: ok"
         )
     return rc
 

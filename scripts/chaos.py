@@ -27,7 +27,11 @@ promises (utils/checkpoint.py):
   surviving checkpoint must carry the EF residual leaves, the resume
   must restore them (or cleanly zero them with ONE structured
   ``ef_reset`` event), and the resumed losses must rejoin the
-  uninterrupted compressed baseline's envelope.
+  uninterrupted compressed baseline's envelope.  The hierarchical
+  sub-leg (ISSUE 16) repeats the schedule at ``--comm-slices 2`` on 4
+  devices and additionally requires the surviving residual leaves to be
+  keyed per hop (``@dcn``) — proving the per-hop EF state survives
+  SIGKILL + resume.
 - **CKPTBENCH** (``--bench``) — measures the two durability numbers the
   ROADMAP asks for: save overhead (wall time of N checkpointed steps vs
   the same N without) and time-to-first-step on resume; writes
@@ -361,74 +365,89 @@ def _nan_leg(steps: int = 12, inject_at: int = 7) -> None:
 # uninterrupted compressed baseline's envelope.
 
 
-def _comm_cmd(work: str, steps: int) -> list[str]:
+def _comm_cmd(work: str, steps: int, hier: bool = False) -> list[str]:
     cmd = _base_cmd(
         work, steps, ["--resume-elastic", "--comm-compress", "int8"]
     )
-    # Compression needs a mesh: 2 virtual CPU devices (train.py forces
-    # xla_force_host_platform_device_count in the subprocess).
+    # Compression needs a mesh: virtual CPU devices (train.py forces
+    # xla_force_host_platform_device_count in the subprocess).  The
+    # hierarchical leg (ISSUE 16) emulates 2 slices x 2 devices via
+    # --comm-slices, which moves the EF residuals to the DCN hop.
     i = cmd.index("--num-devices")
-    cmd[i + 1] = "2"
+    cmd[i + 1] = "4" if hier else "2"
+    if hier:
+        cmd += ["--comm-slices", "2"]
     return cmd
 
 
-def _comm_leg(steps: int = 8) -> None:
+def _comm_leg(steps: int = 8, hier: bool = False) -> None:
     from batchai_retinanet_horovod_coco_tpu.utils.checkpoint import (
         read_manifest,
     )
 
+    tag = "comm-hier" if hier else "comm"
     # Uninterrupted compressed baseline (its own losses — int8+EF drifts
     # from the exact run by design, so the envelope is compressed-vs-
     # compressed).
-    base = _fresh_workdir("comm_base")
-    r = _run(_comm_cmd(base, steps))
+    base = _fresh_workdir(f"{tag}_base".replace("-", "_"))
+    r = _run(_comm_cmd(base, steps, hier))
     check(
         r.returncode == 0,
-        f"comm: baseline failed rc={r.returncode}: {r.stderr[-500:]}",
+        f"{tag}: baseline failed rc={r.returncode}: {r.stderr[-500:]}",
     )
     baseline = _losses_by_step(os.path.join(base, "logs", "metrics.jsonl"))
     check(
         baseline.get(steps) is not None,
-        f"comm: baseline never reached step {steps}",
+        f"{tag}: baseline never reached step {steps}",
     )
 
-    work = _fresh_workdir("comm_kill")
-    cmd = _comm_cmd(work, steps)
+    work = _fresh_workdir(f"{tag}_kill".replace("-", "_"))
+    cmd = _comm_cmd(work, steps, hier)
     r = _run(cmd, env_extra={"RETINANET_CHAOS_KILL": "tmp_write@2"})
     check(
         r.returncode != 0,
-        "comm: mid-save kill never fired (rc 0 — schedule vacuous)",
+        f"{tag}: mid-save kill never fired (rc 0 — schedule vacuous)",
     )
-    _validate_ckpt_dir(work, "comm")
+    _validate_ckpt_dir(work, tag)
     manifest = read_manifest(os.path.join(work, "ckpt"))
-    check(manifest is not None, "comm: no restorable checkpoint survived")
+    check(manifest is not None, f"{tag}: no restorable checkpoint survived")
     if manifest is not None:
-        has_ef = any(
-            e["path"].startswith("['comm_state']")
+        ef_paths = [
+            e["path"]
             for e in manifest.get("leaves", [])
-        )
+            if e["path"].startswith("['comm_state']")
+        ]
         check(
-            has_ef,
-            "comm: surviving checkpoint carries no EF residual leaves "
+            bool(ef_paths),
+            f"{tag}: surviving checkpoint carries no EF residual leaves "
             "(comm_state was not checkpointed)",
         )
+        if hier:
+            # The hierarchical tree keys its residuals per hop — the
+            # checkpoint must carry the @dcn layout, or a resume would
+            # silently zero them (layout mismatch -> ef_reset).
+            check(
+                any("@dcn" in p for p in ef_paths),
+                f"{tag}: EF residual leaves are not keyed per hop "
+                f"(no @dcn in {ef_paths})",
+            )
     resume = _run(cmd)
     check(
         resume.returncode == 0,
-        f"comm: resume failed rc={resume.returncode}: "
+        f"{tag}: resume failed rc={resume.returncode}: "
         f"{resume.stderr[-500:]}",
     )
     metrics = os.path.join(work, "logs", "metrics.jsonl")
     ef_resets = _events(metrics, "ef_reset")
     check(
         len(ef_resets) <= 1,
-        f"comm: expected 0 (restored) or 1 (cleanly zeroed) ef_reset "
+        f"{tag}: expected 0 (restored) or 1 (cleanly zeroed) ef_reset "
         f"events, got {len(ef_resets)}",
     )
     losses = _losses_by_step(metrics)
     check(
         losses.get(steps) is not None,
-        f"comm: resumed run never reached step {steps}",
+        f"{tag}: resumed run never reached step {steps}",
     )
     # Same world size + --resume-elastic: a restore that carried the EF
     # state replays the baseline essentially exactly (tight envelope);
@@ -445,7 +464,7 @@ def _comm_leg(steps: int = 8) -> None:
     }
     check(
         not bad,
-        f"comm: resumed losses left the baseline envelope: {bad}",
+        f"{tag}: resumed losses left the baseline envelope: {bad}",
     )
     if not _failures:
         shutil.rmtree(base, ignore_errors=True)
@@ -1102,6 +1121,8 @@ def main(argv=None) -> int:
 
     if args.comm:
         _comm_leg()
+        if not _failures:
+            _comm_leg(hier=True)  # per-hop EF durability (ISSUE 16)
         print(json.dumps({
             "chaos": "ok" if not _failures else "FAIL",
             "failures": _failures,
@@ -1138,6 +1159,8 @@ def main(argv=None) -> int:
             _nan_leg()
         if not _failures:
             _comm_leg()  # compression+EF durability (ISSUE 13)
+        if not _failures:
+            _comm_leg(hier=True)  # per-hop EF durability (ISSUE 16)
         if not _failures:
             run_serve_legs()  # the serve-side half of the full schedule
         print(f"# chaos: {kills} scheduled kills executed", flush=True)

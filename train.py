@@ -468,7 +468,12 @@ def _start_telemetry(args, logger):
          # Gradient-compression EF health (ISSUE 13): always armed —
          # silent until the train_ef_residual gauge exists, i.e. on
          # every run without --comm-compress.
-         slo.ef_residual_spike()]
+         slo.ef_residual_spike(),
+         # Per-hop variant (ISSUE 16): the DCN hop is the only one
+         # that quantizes under a hierarchical topology; silent until
+         # the train_ef_residual_dcn gauge exists (flat runs never
+         # create it).
+         slo.ef_residual_spike(hop="dcn")]
         + [slo.parse_rule(s) for s in rule_specs],
         sink=logger,
         poll_interval=getattr(args, "slo_poll_s", 5.0),
@@ -687,7 +692,10 @@ def _run(args) -> dict[str, float]:
         RetinaNetConfig,
         build_retinanet,
     )
-    from batchai_retinanet_horovod_coco_tpu.parallel import make_mesh
+    from batchai_retinanet_horovod_coco_tpu.parallel import (
+        derive_topology,
+        make_mesh,
+    )
     from batchai_retinanet_horovod_coco_tpu.train import create_train_state
     from batchai_retinanet_horovod_coco_tpu.train.loop import LoopConfig, run_training
     from batchai_retinanet_horovod_coco_tpu.train.optim import (
@@ -816,9 +824,26 @@ def _run(args) -> dict[str, float]:
                 "error"
             )
         mesh = make_mesh_2d(data_size, spatial_shards)
+        comm_topology = None  # spatial mesh: no hierarchical comm path
     else:
         data_size = num_devices
-        mesh = make_mesh(num_devices) if num_devices > 1 else None
+        # Two-level comm topology (ISSUE 16): --comm-slices / the env
+        # override / real per-device slice indices resolve to slice ×
+        # intra-slice grouping; None on flat (single-slice) machines.
+        # Derived BEFORE the mesh so device order interleaves slices
+        # (mesh position d on slice d % S) — the invariant that keeps
+        # hierarchical EF residuals in global bucket order for
+        # checkpoint resharding.
+        comm_topology = (
+            derive_topology(num_devices, getattr(args, "comm_slices", None))
+            if num_devices > 1
+            else None
+        )
+        mesh = (
+            make_mesh(num_devices, topology=comm_topology)
+            if num_devices > 1
+            else None
+        )
     if args.batch_size % data_size:
         raise SystemExit(
             f"--batch-size {args.batch_size} not divisible by the data-mesh "
@@ -944,7 +969,8 @@ def _run(args) -> dict[str, float]:
 
             state = state.replace(
                 comm_state=init_comm_state(
-                    state.params, comm_cfg, mesh.size, zero=shard_update
+                    state.params, comm_cfg, mesh.size, zero=shard_update,
+                    topology=comm_topology,
                 )
             )
         if args.pretrained_backbone:
@@ -1232,6 +1258,7 @@ def _run(args) -> dict[str, float]:
                     anchor_config=anchor_config,
                     shard_weight_update=shard_update,
                     comm=comm_cfg,
+                    topology=comm_topology,
                     allow_data_axis_divergence=args.allow_data_axis_divergence,
                     eval_fn=run_eval_fn,
                     logger=logger,

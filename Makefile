@@ -24,7 +24,8 @@ CLUSTER = python -m batchai_retinanet_horovod_coco_tpu.launch.cluster
 	convergence-full lint lint-obs check-static tune-smoke tunebench \
 	tunebench-check perf-report perf-report-check telemetry-smoke \
 	numerics-smoke chaos chaos-smoke chaos-comm ckptbench \
-	ckptbench-check fleet-smoke fleet-obs-smoke commbench commbench-check
+	ckptbench-check fleet-smoke fleet-obs-smoke stream-smoke commbench \
+	commbench-check
 
 create:
 	$(CLUSTER) create --name $(NAME) --zone $(ZONE) --accelerator $(ACCEL) $(DRYFLAG)
@@ -209,6 +210,16 @@ fleet-smoke:
 fleet-obs-smoke:
 	JAX_PLATFORMS=cpu python scripts/fleet_obs_smoke.py
 
+# Streaming detection smoke (ISSUE 18, scripts/stream_smoke.py): the real
+# fleet CLI + 2 stub-video replicas — 3 seeded drift streams race
+# single-image traffic over HTTP /stream/*, the frame-delta cache must
+# hit on the drift plateaus, track ids must hold stable between scene
+# cuts, and a mid-stream SIGKILL of a pinned replica must re-pin each of
+# its streams with exactly one stream_repinned event and ZERO dropped
+# frames.  CPU-only, no dataset — wired into check-static.
+stream-smoke:
+	JAX_PLATFORMS=cpu python scripts/stream_smoke.py
+
 # CKPTBENCH (ISSUE 11): the two durability numbers — async-save overhead
 # (wall of N checkpointed steps vs the same N without) and resume
 # time-to-first-step — committed as CKPTBENCH.json.  ckptbench-check
@@ -227,8 +238,8 @@ ckptbench-check:
 # run without touching an accelerator (chaos-smoke DOES run a few real
 # CPU training subprocesses over generated synthetic data — budget the
 # job for minutes, not seconds).
-check-static: lint telemetry-smoke numerics-smoke chaos-smoke fleet-smoke fleet-obs-smoke
-	@echo "check-static: lint engine + watchdog audit + HLO collective audit + telemetry smoke + numerics smoke + chaos smoke + fleet smoke + fleet obs smoke all green"
+check-static: lint telemetry-smoke numerics-smoke chaos-smoke fleet-smoke fleet-obs-smoke stream-smoke
+	@echo "check-static: lint engine + watchdog audit + HLO collective audit + telemetry smoke + numerics smoke + chaos smoke + fleet smoke + fleet obs smoke + stream smoke all green"
 
 # Static watchdog-coverage audit alone (ISSUE 3; now a shim over the lint
 # engine's watchdog-coverage rule — same CLI, same exit codes).  Also runs

@@ -960,6 +960,45 @@ def record_serve_batch(
             g["slot_wait"].observe(float(w))
 
 
+_stream_metrics: dict[str, Any] | None = None
+
+
+def _stream_handles() -> dict[str, Any]:
+    """Lazily-created streaming-detection handles on the default registry
+    (ISSUE 18) — the ``_serve_handles`` pattern: registration is never
+    paid on the disabled path."""
+    global _stream_metrics
+    if _stream_metrics is None:
+        r = default()
+        _stream_metrics = {
+            "hits": r.counter(
+                "serve_stream_cache_hits_total",
+                "frames short-circuited by the frame-delta cache",
+            ),
+            "misses": r.counter(
+                "serve_stream_cache_misses_total",
+                "frames dispatched to the device",
+            ),
+            "latency": r.histogram(
+                "serve_stream_frame_latency_ms",
+                "per-frame submit→deliver latency across all streams",
+            ),
+        }
+    return _stream_metrics
+
+
+def record_stream_frame(cache_hit: bool, latency_ms: float) -> None:
+    """The stream delivery thread's per-frame record site (ISSUE 18;
+    serve/stream.py ``_finish``).  One bool check while telemetry is
+    off."""
+    if not _enabled:
+        return
+    g = _stream_handles()
+    (g["hits"] if cache_hit else g["misses"]).inc()
+    if math.isfinite(latency_ms):
+        g["latency"].observe(float(latency_ms))
+
+
 def record_nonfinite_trip(metric: str) -> None:
     """The loop's abort-path record site: a tripped finite-check counts
     into ``train_nonfinite_total`` (labeled by the tripped metric) so the

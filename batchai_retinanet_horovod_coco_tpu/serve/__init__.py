@@ -27,6 +27,9 @@ Layers (one module each; RUNBOOK §10 is the operator guide):
   rollout (ISSUE 12; RUNBOOK §18), + the fleet HTTP frontend and the
   ``python -m …serve.fleet`` CLI
 - ``stub``     — the canonical no-device stub engine (smoke/chaos/tests)
+- ``stream``   — streaming video sessions over the slot pool (ISSUE 18;
+  RUNBOOK §21): ordered per-stream frames with in-order delivery, IoU
+  track stitching, and the frame-delta result cache
 """
 
 from batchai_retinanet_horovod_coco_tpu.serve.common import (
@@ -54,6 +57,11 @@ from batchai_retinanet_horovod_coco_tpu.serve.replica import (
     LocalReplica,
     ReplicaUnavailable,
 )
+from batchai_retinanet_horovod_coco_tpu.serve.stream import (
+    StreamConfig,
+    StreamManager,
+    TrackStitcher,
+)
 
 __all__ = [
     "DetectEngine",
@@ -71,6 +79,9 @@ __all__ = [
     "ServeError",
     "ServerClosed",
     "ServerError",
+    "StreamConfig",
+    "StreamManager",
+    "TrackStitcher",
     "serve_fleet_http",
     "serve_http",
 ]

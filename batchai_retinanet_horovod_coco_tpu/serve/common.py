@@ -107,6 +107,43 @@ class ServeConfig:
     latency_window: int = 4096
 
 
+@dataclasses.dataclass(frozen=True)
+class StreamConfig:
+    """Streaming-video session knobs (ISSUE 18, serve/stream.py).
+
+    The frame-delta cache and the idle reaper are the two operator
+    levers; everything else bounds per-session resource use so a stream
+    is a polite long-lived client of the slot pool, never a starvation
+    vector (RUNBOOK §21 has the sizing guidance)."""
+
+    # Frame-delta short-circuit: a frame whose decoded pixels differ
+    # from the previous frame by LESS than this mean-absolute-delta
+    # (uint8 counts, averaged over every pixel) returns the previous
+    # frame's detections — track ids preserved — without touching the
+    # device.  0.0 disables the cache entirely: every frame rides the
+    # device, and the stream is bit-identical to the single-image path
+    # (PARITY §5.19 pins this).
+    delta_threshold: float = 2.0
+    # A session with no frame activity for this long (and nothing in
+    # flight) is reaped by the delivery thread — long-lived sessions
+    # must not leak on silent client death.  The manager clock is
+    # injectable for tests (the SlotPool now_fn pattern).
+    idle_timeout_s: float = 30.0
+    # Bounded session table: opens past this shed with stream_limit.
+    max_streams: int = 64
+    # Bounded per-stream in-flight frames: session-aware admission —
+    # one stream can hold at most this many slot-pool rows, so mixed
+    # stream + single-image traffic never starves either class.
+    max_inflight: int = 8
+    # Track stitching (host-side IoU matching over consecutive frames).
+    track_iou: float = 0.3
+    # A track unmatched for this many consecutive device-served frames
+    # is dropped (its id is never reused within the session).
+    track_max_misses: int = 5
+    # Bounded window of recent frame latencies per session (p99 source).
+    latency_window: int = 2048
+
+
 class DetectionFuture:
     """The caller-side handle ``submit()`` returns.
 

@@ -85,6 +85,7 @@ import os
 import random
 import sys
 import threading
+import uuid
 import zlib
 from typing import Any
 
@@ -188,6 +189,51 @@ class FleetConfig:
     canary_drain_timeout_s: float = 5.0
     latency_window: int = 4096
     seed: int = 0
+    # Streaming affinity (ISSUE 18): a fleet-edge stream pin with no
+    # frame activity for this long is dropped by the health-poll sweep
+    # (the replica-side session reaps itself independently; a client
+    # returning after a reap gets ``unknown_stream`` and re-opens).
+    stream_idle_timeout_s: float = 60.0
+
+
+# Backend rejection reasons raised BEFORE the replica's StreamManager
+# consumes the frame's sequence number (its admission checks).  Anything
+# else that surfaces after admission — decode_error, a queue-full shed
+# landing on the frame's future, a deadline expiry — has already
+# advanced the backend's expected seq, and the edge must advance with
+# it or every later frame on the stream sheds ``stream_out_of_order``.
+_STREAM_PRE_ADMISSION = frozenset({
+    "unknown_stream", "stream_out_of_order", "stream_backlogged",
+    "stream_limit", "shutting_down", "no_replica_available",
+})
+
+
+class _StreamPin:
+    """One client stream's fleet-edge affinity record (ISSUE 18): the
+    client-facing session id maps to a pinned replica plus the BACKEND
+    session living on it.  ``lock`` serializes this stream's frames
+    through the edge — monotonic ordering and re-pin atomicity come from
+    the same mutex (concurrency across streams is untouched; one stream's
+    frames are inherently sequential anyway)."""
+
+    __slots__ = (
+        "sid", "lock", "st", "backend_sid", "backend_seq", "next_seq",
+        "width", "height", "trace_id", "last_active", "repins",
+    )
+
+    def __init__(self, sid, st, backend_sid, width, height, trace_id,
+                 now: float):
+        self.sid = sid
+        self.lock = threading.Lock()
+        self.st = st  # the pinned _ReplicaState
+        self.backend_sid = backend_sid
+        self.backend_seq = 0  # the PINNED replica's expected seq
+        self.next_seq = 0  # the CLIENT-facing expected seq
+        self.width = width
+        self.height = height
+        self.trace_id = trace_id
+        self.last_active = now
+        self.repins = 0
 
 
 class _ReplicaState:
@@ -233,6 +279,7 @@ class FleetRouter:
         self.stats = LatencyStats(window=config.latency_window)
         self._states = [_ReplicaState(r) for r in replicas]
         self._lock = threading.Lock()
+        self._emit_lock = threading.Lock()
         self._rng = random.Random(config.seed)
         self._accepting = True
         self._inflight = 0
@@ -240,6 +287,9 @@ class FleetRouter:
         self._redispatches = 0
         self._breaker_opens = 0
         self._rollbacks = 0
+        # Streaming affinity (ISSUE 18): client session id → pin.
+        self._streams: dict[str, _StreamPin] = {}
+        self._stream_repins = 0
         # Canary machinery (armed by add_canary).
         self._canary: _ReplicaState | None = None
         self._canary_monitor = None
@@ -311,6 +361,7 @@ class FleetRouter:
                 code, payload = 0, {"status": "poll_error", "error": repr(exc)}
             self._apply_poll(st, code, payload, now)
         self._recompute_weights()
+        self._reap_stale_pins(now)
 
     def _apply_poll(
         self, st: _ReplicaState, code: int, payload: dict, now: float
@@ -570,6 +621,262 @@ class FleetRouter:
     def _raise_pending(self) -> None:
         if self._error is not None:
             raise ServerError("fleet health poller crashed") from self._error
+
+    # ---- streaming session affinity (ISSUE 18) ---------------------------
+
+    def stream_open(
+        self,
+        width: int | None = None,
+        height: int | None = None,
+        trace_id: str | None = None,
+    ) -> dict:
+        """Open a client stream: pick a replica with the weighted draw,
+        open a BACKEND session on it, and pin the stream there — every
+        subsequent frame routes to the pin (the delta cache and track
+        stitcher are per-replica state; affinity is what makes them
+        work).  The client-facing session id is minted HERE, decoupled
+        from the backend id, so a re-pin is invisible to the client."""
+        self._raise_pending()
+        with self._lock:
+            accepting = self._accepting
+        if not accepting:
+            self.stats.record_shed("shutting_down")
+            raise RequestRejected("shutting_down")
+        if trace_id is None and trace.enabled():
+            trace_id = trace.new_trace_id()
+        tried: set[int] = set()
+        last_exc: BaseException | None = None
+        for _ in range(self.config.redispatch_limit + 1):
+            st = self._pick(tried)
+            if st is None:
+                break
+            tried.add(id(st))
+            try:
+                out = st.replica.stream_open(
+                    width=width, height=height, trace_id=trace_id
+                )
+            except ReplicaUnavailable as exc:
+                self._note_request_failure(st)
+                self._recompute_weights()
+                last_exc = exc
+                continue
+            except RequestRejected as exc:
+                # A per-replica session-table limit: try elsewhere.
+                self._note_request_shed(st)
+                last_exc = exc
+                continue
+            sid = uuid.uuid4().hex[:12]
+            pin = _StreamPin(
+                sid, st, out["session"], width, height, trace_id,
+                monotonic_s(),
+            )
+            with self._lock:
+                self._streams[sid] = pin
+            return {
+                "session": sid,
+                "bucket": out.get("bucket"),
+                "replica_id": st.replica.replica_id,
+            }
+        if isinstance(last_exc, RequestRejected):
+            self.stats.record_shed(last_exc.reason)
+            raise last_exc
+        self.stats.record_shed("no_replica_available")
+        raise RequestRejected(
+            "no_replica_available", "no routable replica for stream open"
+        ) from last_exc
+
+    def stream_frame(
+        self,
+        session_id: str,
+        seq: int,
+        payload,
+        timeout_s: float | None = None,
+        trace_id: str | None = None,
+    ) -> tuple[list[dict], bool]:
+        """Route one frame to the stream's pinned replica; returns
+        ``(detections, cache_hit)``.  On replica death the frame is NOT
+        dropped: the breaker path re-pins the stream to another replica
+        (one structured ``stream_repinned`` event) and retries the frame
+        there — the new backend session starts a fresh track/cache
+        history, which is the documented continuity cost of a kill."""
+        self._raise_pending()
+        with self._lock:
+            pin = self._streams.get(session_id)
+        if pin is None:
+            self.stats.record_shed("unknown_stream")
+            raise RequestRejected("unknown_stream", session_id)
+        if timeout_s is None:
+            timeout_s = self.config.default_timeout_s
+        with pin.lock:
+            pin.last_active = monotonic_s()
+            if seq != pin.next_seq:
+                raise RequestRejected(
+                    "stream_out_of_order",
+                    f"got seq {seq}, expected {pin.next_seq}",
+                )
+            pin.next_seq += 1
+            return self._stream_dispatch(
+                pin, seq, payload, timeout_s, trace_id or pin.trace_id
+            )
+
+    def _stream_dispatch(
+        self, pin: _StreamPin, seq: int, payload, timeout_s, trace_id
+    ) -> tuple[list[dict], bool]:
+        """Under ``pin.lock``: one frame's pin → (maybe re-pin) → retry
+        arc.  Bounded attempts; client-fault rejections propagate
+        immediately (never a re-pin signal)."""
+        last_exc: BaseException | None = None
+        reopened = False
+        for _attempt in range(3):
+            st = pin.st
+            with self._lock:
+                routable = st.state == CLOSED and st.weight > 0.0
+            if routable:
+                try:
+                    dets, hit = st.replica.stream_frame(
+                        pin.backend_sid, pin.backend_seq, payload,
+                        timeout_s=timeout_s, trace_id=trace_id,
+                    )
+                except ReplicaUnavailable as exc:
+                    last_exc = exc
+                    self._note_request_failure(st)
+                    self._recompute_weights()
+                except RequestRejected as exc:
+                    if (
+                        exc.reason in ("unknown_stream",
+                                       "stream_out_of_order")
+                        and not reopened
+                    ):
+                        # unknown_stream: the pinned replica no longer
+                        # knows our backend session (supervisor respawned
+                        # it in place, or its idle reaper fired).
+                        # stream_out_of_order: the edge's and backend's
+                        # seq counters drifted (e.g. an ambiguous
+                        # transport timeout whose frame did or did not
+                        # reach the backend) — the edge enforces client
+                        # ordering itself, so a backend ordering reject
+                        # can only mean drift.  Both resync the same
+                        # way: re-open on the SAME replica — affinity
+                        # survives, history resets.
+                        reopened = True
+                        try:
+                            out = st.replica.stream_open(
+                                width=pin.width, height=pin.height,
+                                trace_id=trace_id,
+                            )
+                            pin.backend_sid = out["session"]
+                            pin.backend_seq = 0
+                            continue
+                        except (ReplicaUnavailable, RequestRejected) as e2:
+                            last_exc = e2
+                            self._note_request_failure(st)
+                            self._recompute_weights()
+                    else:
+                        # Backlog/decode/etc: the frame's outcome, not a
+                        # replica-death signal — surface it.
+                        if exc.reason == "stream_backlogged":
+                            self._note_request_shed(st)
+                        elif exc.reason not in _STREAM_PRE_ADMISSION:
+                            # Post-admission shed: the backend consumed
+                            # this seq — advance ours in lockstep or the
+                            # stream wedges on stream_out_of_order.
+                            pin.backend_seq += 1
+                        self.stats.record_shed(exc.reason)
+                        raise
+                except RequestTimeout:
+                    # The frame was admitted and missed its deadline
+                    # downstream: the backend's seq advanced.  (A
+                    # transport-level timeout that never reached the
+                    # backend leaves the edge one ahead — the
+                    # stream_out_of_order resync above heals that on the
+                    # next frame.)
+                    pin.backend_seq += 1
+                    self.stats.record_timeout()
+                    raise
+                else:
+                    pin.backend_seq += 1
+                    with self._lock:
+                        st.shed_strikes = 0
+                    return dets, hit
+            if not self._repin(pin, seq, trace_id):
+                self.stats.record_shed("no_replica_available")
+                raise RequestRejected(
+                    "no_replica_available",
+                    "stream pin lost and no routable replica left",
+                ) from last_exc
+        self.stats.record_failure()
+        err = ServerError(
+            "stream frame failed after re-pin "
+            f"(stream {pin.sid}, frame {seq})"
+        )
+        err.__cause__ = last_exc
+        raise err
+
+    def _repin(self, pin: _StreamPin, seq: int, trace_id) -> bool:
+        """Move a stream whose pinned replica died: weighted-draw a new
+        replica (excluding the dead pin), open a fresh backend session,
+        emit exactly ONE structured ``stream_repinned`` event (trace
+        instant + sink + stderr — the ISSUE 14 emit-helper pattern)."""
+        old = pin.st
+        exclude = {id(old)}
+        while True:
+            st = self._pick(exclude)
+            if st is None:
+                return False
+            exclude.add(id(st))
+            try:
+                out = st.replica.stream_open(
+                    width=pin.width, height=pin.height, trace_id=trace_id
+                )
+            except (ReplicaUnavailable, RequestRejected) as exc:
+                if isinstance(exc, ReplicaUnavailable):
+                    self._note_request_failure(st)
+                    self._recompute_weights()
+                continue
+            pin.st = st
+            pin.backend_sid = out["session"]
+            pin.backend_seq = 0
+            pin.repins += 1
+            with self._lock:
+                self._stream_repins += 1
+            self._emit_event(
+                "stream_repinned",
+                stream=pin.sid,
+                from_replica=old.replica.replica_id,
+                to_replica=st.replica.replica_id,
+                frame=seq,
+                **({"trace": trace_id} if trace_id else {}),
+            )
+            return True
+
+    def stream_close(self, session_id: str) -> dict:
+        """Drop the pin and close the backend session (best-effort: the
+        pin is gone either way, and the replica's idle reaper backstops
+        a close that never reached it)."""
+        with self._lock:
+            pin = self._streams.pop(session_id, None)
+        if pin is None:
+            raise RequestRejected("unknown_stream", session_id)
+        with pin.lock:
+            try:
+                return pin.st.replica.stream_close(pin.backend_sid)
+            except (ReplicaUnavailable, RequestRejected):
+                return {}
+
+    def _reap_stale_pins(self, now: float) -> None:
+        """Drop fleet-edge pins idle past ``stream_idle_timeout_s``
+        (poll-thread housekeeping; the replica-side session reaps its own
+        state independently)."""
+        timeout = self.config.stream_idle_timeout_s
+        with self._lock:
+            stale = [
+                sid for sid, pin in self._streams.items()
+                if now - pin.last_active > timeout
+            ]
+            for sid in stale:
+                self._streams.pop(sid, None)
+        for sid in stale:
+            self._emit_event("fleet_stream_reaped", stream=sid)
 
     # ---- metrics federation (ISSUE 15) -----------------------------------
 
@@ -846,7 +1153,14 @@ class FleetRouter:
                 self.sink.event(kind, **fields)
             except Exception:
                 pass  # a broken sink must not mask the stderr line
-        print(json.dumps(record), file=sys.stderr, flush=True)
+        # One write call per line, serialized: concurrent emitters (e.g.
+        # two streams re-pinning off the same dead replica) must not
+        # interleave partial lines — downstream harnesses parse this
+        # stream as JSONL.
+        line = json.dumps(record) + "\n"
+        with self._emit_lock:
+            sys.stderr.write(line)
+            sys.stderr.flush()
 
     def _canary_baseline_p99(self) -> float | None:
         """Median p99 over CLOSED non-canary replicas (the fleet
@@ -879,6 +1193,8 @@ class FleetRouter:
             inflight = self._inflight
             canary = self._canary
             outcome = self._canary_outcome
+            streams_open = len(self._streams)
+            stream_repins = self._stream_repins
         yield ("fleet_requests_completed_total", "counter",
                "requests completed through the fleet router", None,
                snap["completed"])
@@ -903,6 +1219,12 @@ class FleetRouter:
         yield ("fleet_inflight", "gauge",
                "requests inside the fleet edge right now", None,
                float(inflight))
+        yield ("fleet_streams_open", "gauge",
+               "client streams pinned at the fleet edge (ISSUE 18)",
+               None, float(streams_open))
+        yield ("fleet_stream_repinned_total", "counter",
+               "streams moved to another replica after pin loss", None,
+               float(stream_repins))
         # Fleet-level availability (ISSUE 15): the fraction of non-
         # drained replicas whose breaker is CLOSED — the metric the
         # built-in fleet availability-floor SLO rule
@@ -963,6 +1285,8 @@ class FleetRouter:
                 "inflight": self._inflight,
                 "redispatches": self._redispatches,
                 "breaker_opens": self._breaker_opens,
+                "streams_open": len(self._streams),
+                "stream_repins": self._stream_repins,
                 "canary_rollbacks": self._rollbacks,
                 "canary_outcome": self._canary_outcome,
                 "federated_replicas": sorted(self._federated),
@@ -1075,6 +1399,11 @@ def serve_fleet_http(
 
     POST /detect   → 200 detections; 503 + reason on shed; 504 on
                    deadline; 500 when every replica failed
+    POST /stream/open|frame|close → the streaming session surface
+                   (ISSUE 18), same wire shape as a single replica's
+                   frontend — frames carry X-Retinanet-Stream and
+                   X-Retinanet-Frame headers, the fleet pins each
+                   stream to a replica and re-pins on replica death
     GET  /healthz  → 200 while >= 1 replica is routable, else 503
     GET  /metrics  → Prometheus text over ``router.telemetry``
     GET  /fleet    → per-replica status JSON (also /statusz)
@@ -1117,7 +1446,87 @@ def serve_fleet_http(
             else:
                 self._json(404, {"error": "not_found"})
 
+        def _do_stream(self, trace_id):
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length)
+            try:
+                if self.path == "/stream/open":
+                    spec = json.loads(body) if body else {}
+                    out = router.stream_open(
+                        width=spec.get("width"),
+                        height=spec.get("height"),
+                        trace_id=trace_id,
+                    )
+                    self._json(200, out, trace_id=trace_id)
+                elif self.path == "/stream/frame":
+                    sid = self.headers.get("X-Retinanet-Stream", "")
+                    try:
+                        seq = int(self.headers.get("X-Retinanet-Frame", -1))
+                        deadline_ms = self.headers.get(
+                            "X-Retinanet-Deadline-Ms"
+                        )
+                        timeout_s = (
+                            float(deadline_ms) / 1e3
+                            if deadline_ms else request_timeout_s
+                        )
+                    except ValueError:
+                        # Malformed header → 400 via the taxonomy
+                        # mapping, not the 500 catch-all.
+                        raise RequestRejected(
+                            "decode_error", "malformed stream header"
+                        ) from None
+                    dets, hit = router.stream_frame(
+                        sid, seq, body,
+                        timeout_s=timeout_s,
+                        trace_id=trace_id,
+                    )
+                    self._json(
+                        200,
+                        {
+                            "detections": dets,
+                            "frame": seq,
+                            "cache_hit": hit,
+                        },
+                        trace_id=trace_id,
+                    )
+                elif self.path == "/stream/close":
+                    sid = self.headers.get("X-Retinanet-Stream", "")
+                    stats = router.stream_close(sid)
+                    self._json(
+                        200, {"closed": sid, "stats": stats},
+                        trace_id=trace_id,
+                    )
+                else:
+                    self._json(404, {"error": "not_found"})
+            except RequestRejected as exc:
+                if exc.reason == "unknown_stream":
+                    code = 404
+                elif exc.reason in ("decode_error", "stream_out_of_order"):
+                    code = 400
+                else:
+                    code = 503
+                self._json(
+                    code, {"error": "rejected", "reason": exc.reason},
+                    trace_id=trace_id,
+                )
+            except (RequestTimeout, TimeoutError):
+                self._json(
+                    504, {"error": "deadline_exceeded"}, trace_id=trace_id
+                )
+            except Exception as exc:
+                self._json(
+                    500, {"error": "server_error", "detail": str(exc)},
+                    trace_id=trace_id,
+                )
+
         def do_POST(self):  # noqa: N802
+            if self.path.startswith("/stream/"):
+                trace_id = (
+                    self.headers.get(trace.TRACE_HEADER)
+                    or trace.new_trace_id()
+                )
+                self._do_stream(trace_id)
+                return
             if self.path != "/detect":
                 self._json(404, {"error": "not_found"})
                 return

@@ -554,11 +554,21 @@ def serve_http(
     host: str = "127.0.0.1",
     port: int = 0,
     request_timeout_s: float = 60.0,
+    stream=None,
 ):
     """Wrap a ``DetectionServer`` in a stdlib ``ThreadingHTTPServer``.
 
     POST /detect   (body = encoded image)  → 200 JSON detections,
                    503 + reason on shed, 504 on deadline, 500 on crash
+    POST /stream/open   (JSON {width?, height?}) → 200 {session, bucket}
+    POST /stream/frame  (headers X-Retinanet-Stream + X-Retinanet-Frame,
+                   optional X-Retinanet-Deadline-Ms; body = encoded
+                   frame) → 200 {detections (with track_id), frame,
+                   cache_hit}; 404 unknown session, 400 out-of-order /
+                   bad input, 503 backlogged/shed, 504 deadline
+                   (serve/stream.py — ISSUE 18)
+    POST /stream/close  (header X-Retinanet-Stream) → 200 final stats
+    GET  /stream   → 200 JSON per-stream status snapshot
     GET  /stats    → 200 JSON stats snapshot
     GET  /metrics  → 200 Prometheus text exposition (server.telemetry)
     GET  /healthz  → TRUTHFUL liveness, split from /stats (ISSUE 9
@@ -579,9 +589,29 @@ def serve_http(
     HTTP client must never hang on a wedged pipeline (the watchdog names
     the wedge; the client gets a 504).  Returns the ``http.server``
     instance; the caller owns ``serve_forever()`` / ``shutdown()`` (the
-    CLI below runs it).
+    CLI below runs it).  The stream manager is created lazily on first
+    streaming use and closed by ``server_close()``, so callers need no
+    extra teardown step.
     """
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    # Streaming sessions ride the same frontend, but the manager (and
+    # its delivery thread) is created lazily on the first /stream*
+    # request: image-only servers never pay for it, and every existing
+    # ``shutdown(); server_close()`` teardown stays leak-free because
+    # ``server_close`` below also closes the manager if one was made.
+    _stream_lock = threading.Lock()
+    _stream_holder = [stream]
+
+    def _stream():
+        with _stream_lock:
+            if _stream_holder[0] is None:
+                from batchai_retinanet_horovod_coco_tpu.serve.stream import (
+                    StreamManager,
+                )
+
+                _stream_holder[0] = StreamManager(server)
+            return _stream_holder[0]
 
     class Handler(BaseHTTPRequestHandler):
         def _json(
@@ -605,6 +635,8 @@ def serve_http(
                 code, payload = telemetry.healthz()
                 payload["load"] = server.load_fields()
                 self._json(code, payload)
+            elif self.path == "/stream":
+                self._json(200, _stream().status())
             elif self.path == "/metrics":
                 body = server.telemetry.prometheus_text().encode()
                 self.send_response(200)
@@ -618,7 +650,106 @@ def serve_http(
             else:
                 self._json(404, {"error": "not_found"})
 
+        def _stream_rejected(self, exc, trace_id):
+            """The stream flavor of the taxonomy → status-code mapping:
+            a dead/unknown session is 404 (re-open, don't retry), client
+            protocol faults (bad input, out-of-order frame) are 400,
+            everything transient is 503."""
+            if exc.reason == "unknown_stream":
+                code = 404
+            elif exc.reason in ("decode_error", "stream_out_of_order"):
+                code = 400
+            else:
+                code = 503
+            self._json(
+                code, {"error": "rejected", "reason": exc.reason},
+                trace_id=trace_id,
+            )
+
+        def _do_stream(self, trace_id):
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length)
+            try:
+                if self.path == "/stream/open":
+                    spec = json.loads(body) if body else {}
+                    out = _stream().open_stream(
+                        width=spec.get("width"),
+                        height=spec.get("height"),
+                        trace_id=trace_id,
+                    )
+                    self._json(200, out, trace_id=trace_id)
+                elif self.path == "/stream/frame":
+                    sid = self.headers.get("X-Retinanet-Stream", "")
+                    try:
+                        seq = int(self.headers.get("X-Retinanet-Frame", -1))
+                        deadline_ms = self.headers.get(
+                            "X-Retinanet-Deadline-Ms"
+                        )
+                        timeout_s = (
+                            float(deadline_ms) / 1e3
+                            if deadline_ms else None
+                        )
+                    except ValueError:
+                        # A malformed header is the client's fault: 400
+                        # via the taxonomy mapping, not a dropped
+                        # connection.
+                        raise RequestRejected(
+                            "decode_error", "malformed stream header"
+                        ) from None
+                    fut = _stream().submit_frame(
+                        sid, seq, body,
+                        timeout_s=timeout_s,
+                        trace_id=trace_id,
+                    )
+                    dets = fut.result(timeout=request_timeout_s)
+                    self._json(
+                        200,
+                        {
+                            "detections": dets,
+                            "frame": seq,
+                            "cache_hit": bool(
+                                getattr(fut, "cache_hit", False)
+                            ),
+                        },
+                        trace_id=trace_id,
+                    )
+                elif self.path == "/stream/close":
+                    sid = self.headers.get("X-Retinanet-Stream", "")
+                    stats = _stream().close_stream(sid)
+                    self._json(
+                        200, {"closed": sid, "stats": stats},
+                        trace_id=trace_id,
+                    )
+                else:
+                    self._json(404, {"error": "not_found"})
+            except RequestRejected as exc:
+                self._stream_rejected(exc, trace_id)
+            except (RequestTimeout, TimeoutError):
+                self._json(
+                    504, {"error": "deadline_exceeded"}, trace_id=trace_id
+                )
+            except ServeError as exc:
+                self._json(
+                    500, {"error": "server_error", "detail": str(exc)},
+                    trace_id=trace_id,
+                )
+            except Exception as exc:
+                # Same catch-all the fleet frontend carries: an
+                # unexpected handler fault answers 500 instead of
+                # closing the connection mid-request.
+                self._json(
+                    500, {"error": "server_error", "detail": str(exc)},
+                    trace_id=trace_id,
+                )
+
         def do_POST(self):  # noqa: N802
+            if self.path.startswith("/stream/"):
+                trace_id = (
+                    self.headers.get(trace.TRACE_HEADER)
+                    or trace.new_trace_id()
+                )
+                self._do_stream(trace_id)
+                return
             if self.path != "/detect":
                 self._json(404, {"error": "not_found"})
                 return
@@ -657,7 +788,23 @@ def serve_http(
         def log_message(self, *args) -> None:
             pass  # request logging is the stats/obs layer's job
 
-    return ThreadingHTTPServer((host, port), Handler)
+    class _ServeHTTPServer(ThreadingHTTPServer):
+        # ``stream_manager`` creates on first touch (same lazy path the
+        # handlers use); ``server_close`` tears down whatever exists so
+        # the standard ``shutdown(); server_close()`` teardown never
+        # leaks the delivery thread.
+        @property
+        def stream_manager(self):
+            return _stream()
+
+        def server_close(self):
+            with _stream_lock:
+                mgr = _stream_holder[0]
+            if mgr is not None:
+                mgr.close()
+            super().server_close()
+
+    return _ServeHTTPServer((host, port), Handler)
 
 
 # ---- CLI -----------------------------------------------------------------
@@ -687,6 +834,12 @@ def build_parser():
     p.add_argument("--stub-delay-ms", type=float, default=0.0,
                    help="stub engine per-dispatch delay (simulated "
                         "device time; lets harnesses shape p99)")
+    p.add_argument("--stub-video", action="store_true",
+                   help="stub engine video mode (ISSUE 18): each row's "
+                        "boxes derive from that row's pixel brightness, "
+                        "so seeded drift footage yields deterministic "
+                        "drifting boxes — the streaming smoke/tests "
+                        "replica")
     mode = p.add_mutually_exclusive_group(required=True)
     mode.add_argument("--http", type=int, metavar="PORT",
                       help="start the HTTP frontend on this port "
@@ -748,7 +901,9 @@ def main(argv: list[str] | None = None) -> dict:
             StubDetectEngine,
         )
 
-        engine = StubDetectEngine(delay_s=args.stub_delay_ms / 1e3)
+        engine = StubDetectEngine(
+            delay_s=args.stub_delay_ms / 1e3, video=args.stub_video
+        )
     elif args.export_dir is None:
         raise SystemExit("--export-dir is required (or pass --stub-engine)")
     else:
@@ -863,8 +1018,8 @@ def main(argv: list[str] | None = None) -> dict:
             httpd = serve_http(server, args.host, args.http)
             print(
                 f"serving on http://{httpd.server_address[0]}:"
-                f"{httpd.server_address[1]} (POST /detect; GET /stats "
-                "/metrics /healthz)"
+                f"{httpd.server_address[1]} (POST /detect /stream/*; "
+                "GET /stats /stream /metrics /healthz)"
             )
             try:
                 httpd.serve_forever()
@@ -872,7 +1027,7 @@ def main(argv: list[str] | None = None) -> dict:
                 pass
             finally:
                 httpd.shutdown()
-                httpd.server_close()
+                httpd.server_close()  # also closes the stream manager
         snap = server.snapshot()
         print(json.dumps({"serve_stats": snap}))
         return snap

@@ -105,6 +105,65 @@ class LocalReplica:
         except TimeoutError as exc:  # future wait expired
             raise RequestTimeout(str(exc)) from exc
 
+    # ---- streaming sessions (ISSUE 18) -----------------------------------
+
+    @property
+    def stream_manager(self):
+        """Lazily-attached ``StreamManager`` over this replica's server
+        (one per replica; created on first streaming use so single-image
+        fleets never pay the delivery thread)."""
+        if getattr(self, "_stream", None) is None:
+            from batchai_retinanet_horovod_coco_tpu.serve.stream import (
+                StreamManager,
+            )
+
+            self._stream = StreamManager(self._server)
+        return self._stream
+
+    def stream_open(
+        self,
+        width: int | None = None,
+        height: int | None = None,
+        trace_id: str | None = None,
+    ) -> dict:
+        try:
+            return self.stream_manager.open_stream(
+                width=width, height=height, trace_id=trace_id
+            )
+        except (ServerClosed, ServerError) as exc:
+            raise ReplicaUnavailable(
+                f"replica {self.replica_id} unavailable: {exc}"
+            ) from exc
+
+    def stream_frame(
+        self,
+        session_id: str,
+        seq: int,
+        payload,
+        timeout_s: float | None = None,
+        trace_id: str | None = None,
+    ) -> tuple[list[dict], bool]:
+        try:
+            fut = self.stream_manager.submit_frame(
+                session_id, seq, payload,
+                timeout_s=timeout_s, trace_id=trace_id,
+            )
+            return fut.result(timeout=timeout_s), bool(fut.cache_hit)
+        except (ServerClosed, ServerError) as exc:
+            raise ReplicaUnavailable(
+                f"replica {self.replica_id} unavailable: {exc}"
+            ) from exc
+        except TimeoutError as exc:  # future wait expired
+            raise RequestTimeout(str(exc)) from exc
+
+    def stream_close(self, session_id: str) -> dict:
+        try:
+            return self.stream_manager.close_stream(session_id)
+        except (ServerClosed, ServerError) as exc:
+            raise ReplicaUnavailable(
+                f"replica {self.replica_id} unavailable: {exc}"
+            ) from exc
+
     def metrics_text(self) -> str | None:
         """This replica's Prometheus exposition — the federation scrape
         surface (ISSUE 15; same payload the HTTP frontend's /metrics
@@ -126,6 +185,8 @@ class LocalReplica:
         self._server.close(drain=True, timeout_s=timeout_s)
 
     def close(self) -> None:
+        if getattr(self, "_stream", None) is not None:
+            self._stream.close()
         self._server.close(drain=False)
 
 
@@ -233,6 +294,102 @@ class HttpReplica:
             raise ReplicaUnavailable(
                 f"replica {self.replica_id} unreachable: {e!r}"
             ) from e
+
+    # ---- streaming sessions (ISSUE 18) -----------------------------------
+
+    def _stream_request(
+        self,
+        path: str,
+        data: bytes,
+        headers: dict,
+        timeout_s: float | None,
+        trace_id: str | None,
+    ) -> dict:
+        """POST one /stream/* call with detect()'s exact error mapping
+        plus the 404 flavor (unknown session → ``unknown_stream``, a
+        re-open signal, never a breaker hit)."""
+        req = urllib.request.Request(
+            f"{self.base_url}{path}", data=data, method="POST"
+        )
+        for k, v in headers.items():
+            req.add_header(k, v)
+        if trace_id is not None:
+            req.add_header(trace.TRACE_HEADER, trace_id)
+        timeout = self._timeout_s if timeout_s is None else timeout_s
+        try:
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            body = {}
+            try:
+                body = json.loads(e.read().decode())
+            except Exception:
+                pass
+            if e.code in (400, 404, 503):
+                raise RequestRejected(
+                    str(body.get("reason", "rejected"))
+                ) from e
+            if e.code == 504:
+                raise RequestTimeout("replica deadline exceeded") from e
+            raise ReplicaUnavailable(
+                f"replica {self.replica_id} HTTP {e.code}"
+            ) from e
+        except Exception as e:
+            if isinstance(e, TimeoutError) or isinstance(
+                getattr(e, "reason", None), TimeoutError
+            ):
+                raise RequestTimeout(
+                    f"replica {self.replica_id} timed out"
+                ) from e
+            raise ReplicaUnavailable(
+                f"replica {self.replica_id} unreachable: {e!r}"
+            ) from e
+
+    def stream_open(
+        self,
+        width: int | None = None,
+        height: int | None = None,
+        trace_id: str | None = None,
+    ) -> dict:
+        spec = {}
+        if width:
+            spec["width"] = int(width)
+        if height:
+            spec["height"] = int(height)
+        return self._stream_request(
+            "/stream/open", json.dumps(spec).encode(), {},
+            None, trace_id,
+        )
+
+    def stream_frame(
+        self,
+        session_id: str,
+        seq: int,
+        payload,
+        timeout_s: float | None = None,
+        trace_id: str | None = None,
+    ) -> tuple[list[dict], bool]:
+        if not isinstance(payload, (bytes, bytearray, memoryview)):
+            raise RequestRejected(
+                "decode_error", "HTTP replicas take encoded frame bytes"
+            )
+        headers = {
+            "X-Retinanet-Stream": session_id,
+            "X-Retinanet-Frame": str(int(seq)),
+        }
+        if timeout_s is not None:
+            headers["X-Retinanet-Deadline-Ms"] = str(timeout_s * 1e3)
+        out = self._stream_request(
+            "/stream/frame", bytes(payload), headers, timeout_s, trace_id
+        )
+        return out["detections"], bool(out.get("cache_hit", False))
+
+    def stream_close(self, session_id: str) -> dict:
+        out = self._stream_request(
+            "/stream/close", b"",
+            {"X-Retinanet-Stream": session_id}, None, None,
+        )
+        return out.get("stats", {})
 
     def metrics_text(self) -> str | None:
         """GET /metrics — the federation scrape surface (ISSUE 15).

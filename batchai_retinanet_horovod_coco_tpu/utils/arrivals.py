@@ -1,0 +1,76 @@
+"""Seeded open-loop arrival schedules — the shared load-shape vocabulary.
+
+``bench.py --mode serve`` introduced the mixed steady → burst → lull
+schedule as a private helper (ISSUE 14: the load shape that exposes
+deadline-only partial-batch waste); the streaming leg (ISSUE 18) needs
+the SAME generator for per-stream frame traces plus a multi-stream
+composition, and a bench-private copy would drift.  One module, pure
+NumPy, no serve imports — both bench legs and the stream smoke build
+their offered load here, and the unit tests pin determinism per seed
+(same seed ⇒ byte-identical schedule ⇒ comparable runs).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: The canonical phase multipliers: steady → burst → lull, cycling.
+MIXED_PHASES = (1.0, 1.8, 0.7)
+
+
+def mixed_arrival_schedule(
+    n: int,
+    base_rate: float,
+    seed: int = 0,
+    phases: tuple[float, ...] = MIXED_PHASES,
+) -> list[float]:
+    """Seeded open-loop MIXED arrival times (absolute seconds): cycling
+    steady → burst → lull phases of exponential inter-arrivals — the
+    load shape that exposes deadline-only partial-batch waste (ISSUE
+    14).  Same seed ⇒ same offered load, so two legs (continuous vs
+    deadline, stream vs single-image) race the identical schedule."""
+    rng = np.random.default_rng(seed)
+    phase_len = max(1, n // 6)
+    t, times = 0.0, []
+    for i in range(n):
+        rate = base_rate * phases[(i // phase_len) % len(phases)]
+        t += float(rng.exponential(1.0 / rate))
+        times.append(t)
+    return times
+
+
+def multi_stream_schedule(
+    n_streams: int,
+    frames_per_stream: int,
+    fps: float,
+    seed: int = 0,
+    jitter: float = 0.25,
+) -> list[list[float]]:
+    """Per-stream frame arrival times for ``n_streams`` concurrent video
+    sessions (absolute seconds, one sorted list per stream).
+
+    Video is NOT Poisson: frames tick at ~``fps`` with bounded capture
+    jitter, and streams start staggered (stream k opens k/fps seconds
+    in, so session opens don't align artificially).  Jitter is drawn
+    from the SAME seeded generator family as the mixed schedule — the
+    whole multi-stream trace is a pure function of ``seed``."""
+    rng = np.random.default_rng(seed)
+    period = 1.0 / max(1e-9, fps)
+    streams = []
+    for k in range(n_streams):
+        start = k * period / max(1, n_streams)
+        offsets = rng.uniform(
+            -jitter * period, jitter * period, size=frames_per_stream
+        )
+        times = [
+            max(0.0, start + i * period + float(offsets[i]))
+            for i in range(frames_per_stream)
+        ]
+        # Capture jitter must never reorder frames: a video client sends
+        # frame i before frame i+1 by construction.
+        times.sort()
+        streams.append(times)
+    return streams
+
+
+__all__ = ["MIXED_PHASES", "mixed_arrival_schedule", "multi_stream_schedule"]

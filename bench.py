@@ -1467,20 +1467,15 @@ def _serve_overload(engine, hw, batch_size, img) -> dict:
 def _mixed_arrival_schedule(
     n: int, base_rate: float, seed: int = 0
 ) -> list[float]:
-    """Seeded open-loop MIXED arrival times (absolute seconds): cycling
-    steady → burst → lull phases of exponential inter-arrivals — the
-    load shape that exposes deadline-only partial-batch waste (ISSUE
-    14).  Same seed ⇒ same offered load, so the continuous and
-    deadline legs race the identical schedule."""
-    rng = np.random.default_rng(seed)
-    phases = (1.0, 1.8, 0.7)
-    phase_len = max(1, n // 6)
-    t, times = 0.0, []
-    for i in range(n):
-        rate = base_rate * phases[(i // phase_len) % len(phases)]
-        t += float(rng.exponential(1.0 / rate))
-        times.append(t)
-    return times
+    """The seeded steady → burst → lull schedule, now the SHARED helper
+    (ISSUE 18 satellite: utils/arrivals.py — the streaming leg composes
+    multi-stream traces from the same seeded family, and unit tests pin
+    determinism per seed there)."""
+    from batchai_retinanet_horovod_coco_tpu.utils.arrivals import (
+        mixed_arrival_schedule,
+    )
+
+    return mixed_arrival_schedule(n, base_rate, seed)
 
 
 def _open_loop_leg(server, images: list, schedule: list[float]) -> dict:
@@ -1850,6 +1845,234 @@ def check_continuous_against_committed(fresh: dict | None) -> int:
             f"# servebench-check[continuous]: occupancy {c_occ} > "
             f"deadline {d_occ}, p99 ratio {ratio}, "
             f"bit_identical={e2e.get('bit_identical', 'n/a')}: ok"
+        )
+    return rc
+
+
+def run_stream_leg(seed: int = 0) -> dict:
+    """SERVEBENCH streaming leg (ISSUE 18): N seeded drift-footage
+    streams replay a ``multi_stream_schedule`` arrival trace against the
+    stub video engine WHILE a mixed single-image schedule rides the same
+    server — one slot pool serving both client classes.  Reported:
+    frames/sec, per-stream p99, cache hit rate, and the no-starvation
+    evidence (every stream frame AND every single-image request
+    completes).  Pure stub — device-independent, runs on every box."""
+    import threading
+
+    import numpy as np
+
+    from batchai_retinanet_horovod_coco_tpu.serve import (
+        DetectionServer,
+        ServeConfig,
+    )
+    from batchai_retinanet_horovod_coco_tpu.serve.common import (
+        RequestRejected,
+        StreamConfig,
+    )
+    from batchai_retinanet_horovod_coco_tpu.serve.stream import StreamManager
+    from batchai_retinanet_horovod_coco_tpu.serve.stub import (
+        StubDetectEngine,
+        drift_frames,
+    )
+    from batchai_retinanet_horovod_coco_tpu.utils.arrivals import (
+        mixed_arrival_schedule,
+        multi_stream_schedule,
+    )
+
+    n_streams = int(os.environ.get("SERVEBENCH_STREAMS", "3"))
+    frames_per_stream = int(
+        os.environ.get("SERVEBENCH_STREAM_FRAMES", "60")
+    )
+    fps = float(os.environ.get("SERVEBENCH_STREAM_FPS", "30"))
+    n_single = int(os.environ.get("SERVEBENCH_STREAM_SINGLES", "40"))
+    delta_threshold = 2.0
+    engine = StubDetectEngine(batch_sizes=(8,), delay_s=0.01, video=True)
+    server = DetectionServer(
+        engine, ServeConfig(max_delay_ms=5.0), warmup=False
+    )
+    manager = StreamManager(
+        server, StreamConfig(delta_threshold=delta_threshold)
+    )
+    schedules = multi_stream_schedule(
+        n_streams, frames_per_stream, fps, seed=seed
+    )
+    # step 1.0 under threshold 2.0 = hits; a cut every 10 frames forces
+    # periodic misses — both cache paths exercised in every capture.
+    footage = [
+        drift_frames(
+            seed=seed + 10 * k, n=frames_per_stream, step=1.0,
+            cut_every=10,
+        )
+        for k in range(n_streams)
+    ]
+    single_schedule = mixed_arrival_schedule(n_single, base_rate=40.0,
+                                             seed=seed + 999)
+    rng = np.random.default_rng(seed + 500)
+    single_imgs = [
+        rng.integers(0, 256, (64, 64, 3), dtype=np.uint8)
+        for _ in range(4)
+    ]
+
+    stream_stats: list[dict | None] = [None] * n_streams
+    singles_done = [0]
+    errors: list[str] = []
+    t0 = time.perf_counter()
+
+    def stream_client(k: int) -> None:
+        try:
+            sid = manager.open_stream(width=64, height=64)["session"]
+            futs = []
+            for i, at in enumerate(schedules[k]):
+                now = time.perf_counter() - t0
+                if at > now:
+                    time.sleep(at - now)
+                while True:
+                    try:
+                        futs.append(
+                            manager.submit_frame(
+                                sid, i, footage[k][i], timeout_s=30.0
+                            )
+                        )
+                        break
+                    except RequestRejected as exc:
+                        if exc.reason != "stream_backlogged":
+                            raise
+                        time.sleep(0.002)  # open-loop slip, not a drop
+            for f in futs:
+                f.result(timeout=30.0)
+            stream_stats[k] = manager.close_stream(sid)
+        except Exception as e:
+            errors.append(f"stream {k}: {e!r}")
+
+    def single_client() -> None:
+        try:
+            futs = []
+            for i, at in enumerate(single_schedule):
+                now = time.perf_counter() - t0
+                if at > now:
+                    time.sleep(at - now)
+                try:
+                    futs.append(
+                        server.submit(
+                            single_imgs[i % len(single_imgs)],
+                            timeout_s=30.0,
+                        )
+                    )
+                except RequestRejected:
+                    continue  # shed = load signal, not starvation
+            for f in futs:
+                f.result(timeout=30.0)
+            singles_done[0] = len(futs)
+        except Exception as e:
+            errors.append(f"single-image client: {e!r}")
+
+    # watchdog: bench-local load generators, bounded by the join below.
+    threads = [
+        threading.Thread(target=stream_client, args=(k,), daemon=True)
+        for k in range(n_streams)
+    ] + [threading.Thread(target=single_client, daemon=True)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120.0)
+    wall_s = time.perf_counter() - t0
+    status = manager.status()
+    manager.close()
+    server.close(drain=False)
+    if errors:
+        raise RuntimeError(f"stream leg clients failed: {errors}")
+
+    frames_total = sum(s["frames"] for s in stream_stats if s)
+    hits = sum(s["cache_hits"] for s in stream_stats if s)
+    per_stream_p99 = [
+        s.get("p99_ms") for s in stream_stats if s and s.get("p99_ms")
+    ]
+    return {
+        "engine": "stub",
+        "seed": seed,
+        "streams": n_streams,
+        "frames_per_stream": frames_per_stream,
+        "fps": fps,
+        "frames_total": frames_total,
+        "dropped": n_streams * frames_per_stream - frames_total,
+        "frames_per_sec": round(frames_total / wall_s, 2),
+        "cache_hit_rate": round(hits / max(1, frames_total), 4),
+        "cache_bytes_saved": status["cache_bytes_saved"],
+        "per_stream_p99_ms": per_stream_p99,
+        "p99_ms_max": max(per_stream_p99) if per_stream_p99 else None,
+        "single_image": {
+            "requests": n_single,
+            "completed": singles_done[0],
+        },
+    }
+
+
+def check_stream_against_committed(fresh: dict | None) -> int:
+    """The streaming half of servebench-check (ISSUE 18).  Structural
+    contracts are device-independent and always enforced: zero dropped
+    frames, cache hits present (the delta cache is alive), and the
+    mixed single-image traffic completed (no starvation).  The absolute
+    p99/throughput comparisons against the committed record apply only
+    on a same-engine capture, with a wide band — cross-box wall-clock
+    on the stub leg is noisy by design."""
+    try:
+        with open(_artifact_path("SERVEBENCH.json")) as f:
+            committed = json.load(f).get("stream")
+    except (OSError, ValueError) as e:
+        print(f"# servebench-check[stream]: cannot read baseline: {e}")
+        return 1
+    if fresh is None:
+        print("# servebench-check[stream]: leg disabled "
+              "(SERVEBENCH_STREAM=0) — the committed record goes "
+              "UNCHECKED this run")
+        return 0
+    rc = 0
+    if fresh.get("dropped"):
+        print(f"# servebench-check[stream]: {fresh['dropped']} stream "
+              "frames never completed: REGRESSION")
+        rc = 1
+    if not fresh.get("cache_hit_rate"):
+        print("# servebench-check[stream]: zero cache hits on seeded "
+              "drift footage — the frame-delta cache is dead: REGRESSION")
+        rc = 1
+    single = fresh.get("single_image") or {}
+    if not single.get("completed"):
+        print("# servebench-check[stream]: no single-image request "
+              "completed alongside the streams — starvation: REGRESSION")
+        rc = 1
+    if committed is None:
+        print("# servebench-check[stream]: committed SERVEBENCH.json has "
+              "no stream record yet — re-capture with `make servebench`")
+        return rc
+    if committed.get("engine") == fresh.get("engine"):
+        band = float(os.environ.get("SERVEBENCH_STREAM_P99_BAND", "3.0"))
+        c99, f99 = committed.get("p99_ms_max"), fresh.get("p99_ms_max")
+        if c99 and f99 and f99 > band * float(c99):
+            print(
+                f"# servebench-check[stream]: per-stream p99 {f99}ms "
+                f"above {band}x the committed {c99}ms: REGRESSION"
+            )
+            rc = 1
+        floor = 0.5 * float(committed.get("frames_per_sec") or 0.0)
+        if float(fresh.get("frames_per_sec") or 0.0) < floor:
+            print(
+                f"# servebench-check[stream]: frames/sec "
+                f"{fresh.get('frames_per_sec')} under the committed "
+                f"floor {round(floor, 2)}: REGRESSION"
+            )
+            rc = 1
+    else:
+        print(
+            "# servebench-check[stream]: committed leg ran engine="
+            f"{committed.get('engine')}, fresh ran {fresh.get('engine')} "
+            "— absolute bands skipped (structural contracts enforced "
+            "above)"
+        )
+    if rc == 0:
+        print(
+            f"# servebench-check[stream]: {fresh['frames_total']} frames, "
+            f"hit rate {fresh['cache_hit_rate']}, p99max "
+            f"{fresh.get('p99_ms_max')}ms, zero dropped: ok"
         )
     return rc
 
@@ -2246,12 +2469,13 @@ def check_fleet_against_committed(fresh: dict | None) -> int:
 
 def check_serve_against_committed(
     value: float, device_kind: str, fleet: dict | None = None,
-    continuous: dict | None = None,
+    continuous: dict | None = None, stream: dict | None = None,
 ) -> int:
     """servebench-check: fresh flagship closed-loop SERVE rate vs the
     committed SERVEBENCH.json — same floor/device policy as bench-check
-    (``_check_floor``) — plus the fleet availability band (ISSUE 12) and
-    the continuous-batching occupancy/p99 contract (ISSUE 14)."""
+    (``_check_floor``) — plus the fleet availability band (ISSUE 12),
+    the continuous-batching occupancy/p99 contract (ISSUE 14), and the
+    streaming-session contract (ISSUE 18)."""
     try:
         with open(_artifact_path("SERVEBENCH.json")) as f:
             committed = json.load(f)
@@ -2270,6 +2494,7 @@ def check_serve_against_committed(
         rc,
         check_fleet_against_committed(fleet),
         check_continuous_against_committed(continuous),
+        check_stream_against_committed(stream),
     )
 
 
@@ -2337,6 +2562,15 @@ def run_serve_mode() -> None:
                     model, state, batch_size
                 )
         out["continuous"] = cont
+    # Streaming leg (ISSUE 18): seeded drift streams + mixed single-image
+    # traffic through StreamManager over the stub video engine —
+    # device-independent, so it runs (and is checked) on every box.
+    # SERVEBENCH_STREAM=0 skips.
+    stream = None
+    if os.environ.get("SERVEBENCH_STREAM", "1") not in ("", "0"):
+        with obs_trace.span("serve_stream_leg"):
+            stream = run_stream_leg()
+        out["stream"] = stream
     att = _trace_attribution()
     if att is not None:
         out["attribution"] = att
@@ -2344,7 +2578,9 @@ def run_serve_mode() -> None:
 
     if os.environ.get("BENCH_CHECK", "") not in ("", "0"):
         raise SystemExit(
-            check_serve_against_committed(value, device_kind, fleet, cont)
+            check_serve_against_committed(
+                value, device_kind, fleet, cont, stream
+            )
         )
 
 

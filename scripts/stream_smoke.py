@@ -1,0 +1,405 @@
+"""Streaming detection smoke (ISSUE 18, `make stream-smoke`).
+
+The REAL fleet CLI over 2 stub-video replica subprocesses, driving the
+full streaming surface over HTTP end to end on CPU:
+
+1. **mixed traffic** — 3 seeded drift streams (/stream/open + ordered
+   /stream/frame posts) race single-image /detect traffic through the
+   same fleet edge; every class completes;
+2. **frame-delta cache** — the drift plateaus between scene cuts return
+   ``cache_hit: true`` responses (hits > 0), and the fleet's federated
+   /metrics carries the replica-side cache counters;
+3. **track stitching** — every detection carries a ``track_id``, and ids
+   hold stable across the frames between cuts;
+4. **replica kill** — SIGKILL the replica pinned by stream 0 mid-stream:
+   each stream pinned there re-pins to the survivor with exactly one
+   structured ``stream_repinned`` event, and ZERO frames drop — every
+   admitted frame still returns 200 detections (the fleet edge retries
+   the in-flight frame on the new pin);
+5. **close** — /stream/close returns the per-session stats snapshot.
+
+CPU-only, no dataset, no device work — wired into `make check-static`.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+N_STREAMS = 3
+FRAMES = 36
+CUT_EVERY = 12
+KILL_AT_FRAME = 15  # kill once every stream has passed this frame
+N_SINGLES = 30
+
+FAILURES: list[str] = []
+
+
+def check(ok: bool, what: str) -> None:
+    tag = "ok" if ok else "FAIL"
+    print(f"stream-smoke {tag}: {what}", flush=True)
+    if not ok:
+        FAILURES.append(what)
+
+
+def _png(arr) -> bytes:
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, "PNG")
+    return buf.getvalue()
+
+
+def _http(url: str, data: bytes | None = None, headers: dict | None = None,
+          timeout: float = 30.0):
+    """(status, headers dict, body bytes); 4xx/5xx are data."""
+    req = urllib.request.Request(
+        url, data=data, method="POST" if data is not None else "GET"
+    )
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+class Fleet:
+    """The fleet CLI under test + structured stdout/stderr readers."""
+
+    def __init__(self):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        self.proc = subprocess.Popen(
+            [
+                sys.executable, "-m",
+                "batchai_retinanet_horovod_coco_tpu.serve.fleet",
+                "--http", "0", "--spawn", "2", "--stub-engine",
+                "--poll-interval", "0.2", "--respawn-delay-s", "1.0",
+                "--fleet-timeout-s", "20",
+                "--spawn-serve-args=--stub-video",
+            ],
+            env=env, cwd=_REPO, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        self.stdout_lines: list[str] = []
+        self.stderr_lines: list[str] = []
+
+        def reader(stream, into):
+            try:
+                for line in stream:
+                    into.append(line.rstrip("\n"))
+            except Exception as e:
+                into.append(f"__reader_error__ {e!r}")
+
+        # watchdog: harness-local pipe readers; liveness is witnessed by
+        # the driver's own bounded waits, not the obs watchdog.
+        for stream, into in (
+            (self.proc.stdout, self.stdout_lines),
+            (self.proc.stderr, self.stderr_lines),
+        ):
+            threading.Thread(
+                target=reader, args=(stream, into), daemon=True
+            ).start()
+        try:
+            self.base_url = self._wait_for_url()
+        except Exception:
+            self.stop()
+            raise
+
+    def _wait_for_url(self, timeout: float = 180.0) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"fleet CLI died rc={self.proc.returncode}: "
+                    f"{self.stderr_lines[-5:]}"
+                )
+            for line in self.stdout_lines:
+                if line.startswith("fleet serving on "):
+                    return line.split("fleet serving on ", 1)[1].split()[0]
+            time.sleep(0.1)
+        raise RuntimeError("fleet CLI never started serving")
+
+    def events(self, kind: str) -> list[dict]:
+        out = []
+        for line in self.stdout_lines + self.stderr_lines:
+            try:
+                rec = json.loads(line)
+            except (json.JSONDecodeError, ValueError):
+                continue
+            if isinstance(rec, dict) and rec.get("event") == kind:
+                out.append(rec)
+        return out
+
+    def metric(self, key: str) -> float:
+        from batchai_retinanet_horovod_coco_tpu.obs.telemetry import (
+            parse_exposition,
+        )
+
+        code, _h, body = _http(f"{self.base_url}/metrics")
+        if code != 200:
+            return float("nan")
+        _types, samples = parse_exposition(body.decode())
+        return samples.get(key, 0.0)
+
+    def stop(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(timeout=10)
+
+
+class StreamClient:
+    """One video session: ordered frame posts, per-frame bookkeeping."""
+
+    def __init__(self, k: int, base_url: str):
+        from batchai_retinanet_horovod_coco_tpu.serve.stub import (
+            drift_frames,
+        )
+
+        self.k = k
+        self.base_url = base_url
+        self.frames = [
+            _png(fr)
+            for fr in drift_frames(
+                seed=42 + k, n=FRAMES, step=1.0, cut_every=CUT_EVERY
+            )
+        ]
+        self.sid = ""
+        self.replica_id = ""
+        self.sent = 0
+        self.responses: list[dict] = []  # per-frame response docs
+        self.bad: list[tuple[int, int, str]] = []  # (seq, code, body)
+        self.stats: dict = {}
+        self.error: str | None = None
+
+    def open(self) -> None:
+        code, _h, body = _http(
+            f"{self.base_url}/stream/open",
+            data=json.dumps({"width": 64, "height": 64}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        if code != 200:
+            raise RuntimeError(f"stream {self.k} open -> {code}: {body!r}")
+        doc = json.loads(body.decode())
+        self.sid = doc["session"]
+        self.replica_id = doc.get("replica_id", "")
+
+    def run(self) -> None:
+        try:
+            for seq, payload in enumerate(self.frames):
+                code, _h, body = _http(
+                    f"{self.base_url}/stream/frame", data=payload,
+                    headers={
+                        "X-Retinanet-Stream": self.sid,
+                        "X-Retinanet-Frame": str(seq),
+                    },
+                )
+                if code == 200:
+                    self.responses.append(json.loads(body.decode()))
+                else:
+                    # Any non-200 is a DROPPED frame: the fleet edge
+                    # consumed the seq, so there is no legal retry.
+                    self.bad.append((seq, code, body.decode()[:200]))
+                self.sent = seq + 1
+                time.sleep(0.02)  # ~50 fps offered — gentle pacing
+            code, _h, body = _http(
+                f"{self.base_url}/stream/close", data=b"",
+                headers={"X-Retinanet-Stream": self.sid},
+            )
+            if code == 200:
+                self.stats = json.loads(body.decode()).get("stats", {})
+        except Exception as e:  # crash channel: fail loudly, not silently
+            self.error = repr(e)
+
+
+def main() -> int:
+    fleet = Fleet()
+    try:
+        spawned = fleet.events("fleet_replica_spawned")
+        check(len(spawned) == 2, f"2 replicas spawned (saw {len(spawned)})")
+        pid_by_rid = {e["replica_id"]: e["pid"] for e in spawned}
+
+        clients = [StreamClient(k, fleet.base_url) for k in range(N_STREAMS)]
+        for c in clients:
+            c.open()
+        check(
+            all(c.sid for c in clients),
+            f"{N_STREAMS} streams opened, each pinned to a replica "
+            f"({[c.replica_id for c in clients]})",
+        )
+
+        # watchdog: harness-local load generators, bounded by the joins
+        # below.
+        threads = [
+            threading.Thread(target=c.run, daemon=True) for c in clients
+        ]
+        for t in threads:
+            t.start()
+
+        # Single-image traffic mixed through the same edge.
+        import numpy as np
+
+        rng = np.random.default_rng(7)
+        singles_png = _png(
+            rng.integers(0, 255, size=(64, 64, 3)).astype(np.uint8)
+        )
+        single_codes: list[int] = []
+
+        def singles():
+            try:
+                for _ in range(N_SINGLES):
+                    code, _h, _b = _http(
+                        f"{fleet.base_url}/detect", data=singles_png
+                    )
+                    single_codes.append(code)
+                    time.sleep(0.03)
+            except Exception as exc:  # forward into the FAILURES ledger
+                check(False, f"singles generator crashed: {exc!r}")
+
+        # watchdog: harness-local load generator, joined below.
+        single_thread = threading.Thread(target=singles, daemon=True)
+        single_thread.start()
+
+        # Kill stream 0's pinned replica once every stream is mid-flight.
+        deadline = time.monotonic() + 60
+        while any(c.sent < KILL_AT_FRAME for c in clients):
+            if time.monotonic() > deadline:
+                check(False, "streams never reached the kill point")
+                break
+            time.sleep(0.05)
+        victim_rid = clients[0].replica_id
+        pinned_to_victim = [c for c in clients if c.replica_id == victim_rid]
+        os.kill(pid_by_rid[victim_rid], signal.SIGKILL)
+        print(f"stream-smoke: killed {victim_rid} "
+              f"(pinned: {[c.k for c in pinned_to_victim]})", flush=True)
+
+        for t in threads:
+            t.join(timeout=120)
+        single_thread.join(timeout=120)
+        check(
+            not any(t.is_alive() for t in threads)
+            and not single_thread.is_alive(),
+            "all load generators finished",
+        )
+        for c in clients:
+            check(c.error is None, f"stream {c.k} client clean ({c.error})")
+
+        # ---- zero dropped frames across the kill ----------------------
+        for c in clients:
+            check(
+                not c.bad and len(c.responses) == FRAMES,
+                f"stream {c.k}: {len(c.responses)}/{FRAMES} frames served, "
+                f"dropped {c.bad[:3]}",
+            )
+            check(
+                all(
+                    d.get("frame") == i
+                    for i, d in enumerate(c.responses)
+                ),
+                f"stream {c.k}: responses arrived in frame order",
+            )
+
+        # ---- cache hits on the drift plateaus --------------------------
+        hits = sum(
+            1 for c in clients for d in c.responses if d.get("cache_hit")
+        )
+        check(hits > 0, f"frame-delta cache hits > 0 (saw {hits})")
+
+        # ---- track ids present and stable between cuts ------------------
+        for c in clients:
+            dets = [d.get("detections", []) for d in c.responses]
+            check(
+                all(
+                    all("track_id" in dd for dd in frame_dets)
+                    for frame_dets in dets
+                ),
+                f"stream {c.k}: every detection carries track_id",
+            )
+            # Frames 1..KILL_AT_FRAME-1 sit inside drift plateaus before
+            # the kill on the FIRST pin; ids must hold within a cut
+            # segment (the stitcher resets on re-pin, so stop early).
+            seg_end = min(CUT_EVERY, KILL_AT_FRAME)
+            ids = [
+                sorted(dd["track_id"] for dd in frame_dets)
+                for frame_dets in dets[1:seg_end]
+            ]
+            check(
+                all(x == ids[0] for x in ids),
+                f"stream {c.k}: track ids stable across frames "
+                f"1..{seg_end - 1} ({ids[:3]}...)",
+            )
+
+        # ---- exactly one re-pin per stream pinned to the victim --------
+        # (bounded wait: the stderr reader thread can lag the pipe by a
+        # beat, so poll until the expected lines land)
+        deadline = time.monotonic() + 15
+        repins = fleet.events("stream_repinned")
+        while (
+            len(repins) < len(pinned_to_victim)
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.2)
+            repins = fleet.events("stream_repinned")
+        by_stream: dict[str, int] = {}
+        for e in repins:
+            by_stream[e["stream"]] = by_stream.get(e["stream"], 0) + 1
+        expected = {c.sid for c in pinned_to_victim}
+        check(
+            set(by_stream) == expected
+            and all(v == 1 for v in by_stream.values()),
+            f"exactly one stream_repinned per victim-pinned stream "
+            f"({by_stream} vs expected {sorted(expected)})",
+        )
+        check(
+            fleet.metric("fleet_stream_repinned_total")
+            == float(len(expected)),
+            "fleet_stream_repinned_total matches the events",
+        )
+
+        # ---- singles were never starved by the streams ------------------
+        ok = sum(1 for c in single_codes if c == 200)
+        odd = [c for c in single_codes if c not in (200, 503)]
+        check(
+            len(single_codes) == N_SINGLES and not odd
+            and ok >= 0.9 * N_SINGLES,
+            f"single-image traffic served through the kill "
+            f"({ok}/{N_SINGLES} ok, odd codes {odd[:5]})",
+        )
+
+        # ---- close returned per-session stats ---------------------------
+        closable = [c for c in clients if c.replica_id != victim_rid]
+        check(
+            all(c.stats.get("frames", 0) > 0 for c in closable),
+            f"/stream/close returned per-session stats "
+            f"({[c.stats.get('frames') for c in clients]})",
+        )
+    finally:
+        fleet.stop()
+
+    if FAILURES:
+        print(f"stream-smoke: {len(FAILURES)} FAILURE(S): {FAILURES}",
+              flush=True)
+        return 1
+    print("stream-smoke: all checks green", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,783 @@
+"""serve/stream.py — streaming video sessions over the slot pool (ISSUE 18).
+
+Families:
+
+- **TrackStitcher**: stable ids across moving boxes, the category gate,
+  miss-based aging, deterministic greedy matching.
+- **Session contract** (stub engine): monotonic seq enforcement,
+  per-stream in-flight cap (``stream_backlogged``), in-order delivery
+  with a cache hit queued behind an in-flight miss, explicit close, the
+  session cap, idle reaping on the injectable clock.
+- **Frame-delta cache**: hit/miss counters + bytes saved, scene cuts
+  forcing misses, reference-frame convergence under slow drift, and
+  ``delta_threshold=0`` disabling the cache entirely.
+- **Mixed clients**: long-lived streams + one-shot single-image traffic
+  on the SAME server — neither class starves (the SlotPool satellite).
+- **Bit-identity** (PARITY §5.19): with the cache off, the stream path
+  serves byte-identical detections to sequential single-image serving —
+  pinned on the stub AND on the live tiny model at score_threshold
+  0.001.
+- **Fleet affinity**: frames route to the pinned replica; killing it
+  mid-stream re-pins with exactly one ``stream_repinned`` event and
+  zero dropped in-flight frames.
+- **Arrivals** (the shared bench helper): same seed ⇒ byte-identical
+  schedule; per-stream frame times are sorted and non-negative.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from batchai_retinanet_horovod_coco_tpu.serve import (
+    DetectionServer,
+    FleetConfig,
+    FleetRouter,
+    LocalReplica,
+    RequestRejected,
+    ServeConfig,
+    StreamConfig,
+    StreamManager,
+    TrackStitcher,
+)
+from batchai_retinanet_horovod_coco_tpu.serve.stub import (
+    StubDetectEngine,
+    drift_frames,
+)
+from batchai_retinanet_horovod_coco_tpu.utils.arrivals import (
+    mixed_arrival_schedule,
+    multi_stream_schedule,
+)
+from batchai_retinanet_horovod_coco_tpu.utils.backoff import BackoffPolicy
+
+
+def make_server(engine=None, **cfg) -> DetectionServer:
+    cfg.setdefault("max_delay_ms", 10)
+    cfg.setdefault("preprocess_workers", 1)
+    return DetectionServer(
+        engine or StubDetectEngine(video=True), ServeConfig(**cfg)
+    )
+
+
+def _frame(value: int, hw=(64, 64)) -> np.ndarray:
+    return np.full((hw[0], hw[1], 3), value, np.uint8)
+
+
+def _submit_all(mgr, sid, frames, timeout_s=30.0):
+    """Replay ``frames`` in order, retrying ``stream_backlogged`` (the
+    designed per-stream in-flight cap — a real client paces itself the
+    same way).  Returns the resolved detections per frame."""
+    futs = []
+    for seq, fr in enumerate(frames):
+        while True:
+            try:
+                futs.append(mgr.submit_frame(sid, seq, fr))
+                break
+            except RequestRejected as exc:
+                if exc.reason != "stream_backlogged":
+                    raise
+                time.sleep(0.002)
+    return [f.result(timeout=timeout_s) for f in futs]
+
+
+def _strip(dets: list[dict]) -> list[dict]:
+    return [{k: v for k, v in d.items() if k != "track_id"} for d in dets]
+
+
+# ---- TrackStitcher (host-side, no server) --------------------------------
+
+
+class TestTrackStitcher:
+    def test_stable_id_across_moving_box(self):
+        st = TrackStitcher(iou_threshold=0.3)
+        a = [{"category_id": 0, "bbox": [10.0, 10.0, 20.0, 20.0], "score": 0.9}]
+        st.update(a)
+        assert a[0]["track_id"] == 0
+        # Shifted but still overlapping: same track.
+        b = [{"category_id": 0, "bbox": [13.0, 12.0, 20.0, 20.0], "score": 0.9}]
+        st.update(b)
+        assert b[0]["track_id"] == 0
+        assert st.live_tracks == 1
+
+    def test_category_gate_never_continues_other_class(self):
+        st = TrackStitcher(iou_threshold=0.3)
+        a = [{"category_id": 0, "bbox": [10.0, 10.0, 20.0, 20.0], "score": 0.9}]
+        st.update(a)
+        # Identical box, different category: a fresh track, not id 0.
+        b = [{"category_id": 1, "bbox": [10.0, 10.0, 20.0, 20.0], "score": 0.9}]
+        st.update(b)
+        assert b[0]["track_id"] == 1
+
+    def test_track_ages_out_and_id_never_reused(self):
+        st = TrackStitcher(iou_threshold=0.3, max_misses=2)
+        a = [{"category_id": 0, "bbox": [10.0, 10.0, 20.0, 20.0], "score": 0.9}]
+        st.update(a)
+        for _ in range(3):  # misses 1, 2, then 3 > max_misses → dropped
+            st.update([])
+        assert st.live_tracks == 0
+        # The box returns: it gets a NEW id — ids are never recycled.
+        b = [{"category_id": 0, "bbox": [10.0, 10.0, 20.0, 20.0], "score": 0.9}]
+        st.update(b)
+        assert b[0]["track_id"] == 1
+
+    def test_greedy_matching_is_deterministic(self):
+        def run():
+            st = TrackStitcher(iou_threshold=0.1)
+            f0 = [
+                {"category_id": 0, "bbox": [0.0, 0.0, 10.0, 10.0], "score": 0.9},
+                {"category_id": 0, "bbox": [20.0, 20.0, 10.0, 10.0], "score": 0.8},
+            ]
+            st.update(f0)
+            f1 = [
+                {"category_id": 0, "bbox": [21.0, 21.0, 10.0, 10.0], "score": 0.8},
+                {"category_id": 0, "bbox": [1.0, 1.0, 10.0, 10.0], "score": 0.9},
+            ]
+            st.update(f1)
+            return [d["track_id"] for d in f1]
+
+        assert run() == run() == [1, 0]
+
+
+# ---- session contract ----------------------------------------------------
+
+
+class TestSessionContract:
+    def test_out_of_order_seq_sheds_without_advancing(self):
+        with make_server() as srv:
+            mgr = StreamManager(srv)
+            try:
+                sid = mgr.open_stream()["session"]
+                with pytest.raises(RequestRejected) as ei:
+                    mgr.submit_frame(sid, 3, _frame(50))
+                assert ei.value.reason == "stream_out_of_order"
+                # The reject did NOT consume seq 0: in-order still works.
+                dets = mgr.submit_frame(sid, 0, _frame(50)).result(timeout=30)
+                assert dets and all("track_id" in d for d in dets)
+            finally:
+                mgr.close()
+
+    def test_backlogged_stream_sheds_at_inflight_cap(self):
+        # 200ms device time and a 1-frame cap: the second immediate
+        # submit must shed rather than queue unboundedly.
+        engine = StubDetectEngine(video=True, delay_s=0.2)
+        with make_server(engine) as srv:
+            mgr = StreamManager(srv, StreamConfig(max_inflight=1))
+            try:
+                sid = mgr.open_stream()["session"]
+                mgr.submit_frame(sid, 0, _frame(50))
+                with pytest.raises(RequestRejected) as ei:
+                    mgr.submit_frame(sid, 1, _frame(50))
+                assert ei.value.reason == "stream_backlogged"
+            finally:
+                mgr.close()
+
+    def test_cache_hit_resolves_in_order_behind_inflight_miss(self):
+        # Frame 1 is an immediate cache hit on admission, but frame 0's
+        # miss is still on the (200ms-slow) device — the hit must wait
+        # and then serve the MISS's freshly-stitched detections.
+        engine = StubDetectEngine(video=True, delay_s=0.2)
+        with make_server(engine) as srv:
+            mgr = StreamManager(srv, StreamConfig(delta_threshold=2.0))
+            try:
+                sid = mgr.open_stream()["session"]
+                f0 = mgr.submit_frame(sid, 0, _frame(50))
+                f1 = mgr.submit_frame(sid, 1, _frame(50))
+                assert not f0.cache_hit and f1.cache_hit
+                d1 = f1.result(timeout=30)
+                d0 = f0.result(timeout=30)
+                assert d1 == d0  # the hit's payload IS the miss's result
+                assert all("track_id" in d for d in d0)
+            finally:
+                mgr.close()
+
+    def test_unknown_and_closed_sessions_reject(self):
+        with make_server() as srv:
+            mgr = StreamManager(srv)
+            try:
+                with pytest.raises(RequestRejected) as ei:
+                    mgr.submit_frame("nope", 0, _frame(50))
+                assert ei.value.reason == "unknown_stream"
+                sid = mgr.open_stream()["session"]
+                mgr.submit_frame(sid, 0, _frame(50)).result(timeout=30)
+                summary = mgr.close_stream(sid)
+                assert summary["frames"] == 1
+                with pytest.raises(RequestRejected) as ei:
+                    mgr.submit_frame(sid, 1, _frame(50))
+                assert ei.value.reason == "unknown_stream"
+            finally:
+                mgr.close()
+
+    def test_session_cap_sheds_with_stream_limit(self):
+        with make_server() as srv:
+            mgr = StreamManager(srv, StreamConfig(max_streams=1))
+            try:
+                mgr.open_stream()
+                with pytest.raises(RequestRejected) as ei:
+                    mgr.open_stream()
+                assert ei.value.reason == "stream_limit"
+            finally:
+                mgr.close()
+
+    def test_idle_session_reaped_on_injectable_clock(self):
+        clock = [0.0]
+        with make_server() as srv:
+            mgr = StreamManager(
+                srv, StreamConfig(idle_timeout_s=5.0), now_fn=lambda: clock[0]
+            )
+            try:
+                sid = mgr.open_stream()["session"]
+                mgr.submit_frame(sid, 0, _frame(50)).result(timeout=30)
+                # Not idle long enough: survives.
+                clock[0] = 4.0
+                mgr.reap_idle()
+                assert sid in mgr.status()["streams"]
+                # Past the timeout: reaped (the delivery thread races the
+                # explicit call on the same clock — either path retires).
+                clock[0] = 6.0
+                mgr.reap_idle()
+                deadline = time.monotonic() + 5.0
+                while sid in mgr.status()["streams"]:
+                    assert time.monotonic() < deadline, "session never reaped"
+                    time.sleep(0.01)
+                assert mgr.status()["reaped"] == 1
+                with pytest.raises(RequestRejected) as ei:
+                    mgr.submit_frame(sid, 1, _frame(50))
+                assert ei.value.reason == "unknown_stream"
+            finally:
+                mgr.close()
+
+
+# ---- frame-delta cache ---------------------------------------------------
+
+
+class TestDeltaCache:
+    def test_hits_misses_and_bytes_counted(self):
+        with make_server() as srv:
+            mgr = StreamManager(srv, StreamConfig(delta_threshold=2.0))
+            try:
+                sid = mgr.open_stream()["session"]
+                futs = [
+                    mgr.submit_frame(sid, i, _frame(50)) for i in range(4)
+                ]
+                results = [f.result(timeout=30) for f in futs]
+                assert [f.cache_hit for f in futs] == [
+                    False, True, True, True,
+                ]
+                assert results[1:] == [results[0]] * 3
+                status = mgr.status()
+                assert status["cache_hits"] == 3
+                assert status["cache_misses"] == 1
+                assert status["cache_bytes_saved"] == 3 * 64 * 64 * 3
+            finally:
+                mgr.close()
+
+    def test_scene_cut_forces_miss_and_breaks_tracks(self):
+        frames = drift_frames(seed=7, n=12, step=0.2, cut_every=6)
+        with make_server() as srv:
+            mgr = StreamManager(srv, StreamConfig(delta_threshold=2.0))
+            try:
+                sid = mgr.open_stream()["session"]
+                results = _submit_all(mgr, sid, frames)
+                status = mgr.status()["streams"][sid]
+                # Hits on the drift plateaus; the cut at frame 6 (mean
+                # jump ≥ 30) forces a device pass.
+                assert status["cache_hits"] >= 1
+                assert status["cache_misses"] >= 2
+                # The cut's new brightness moves the boxes: fresh tracks.
+                ids_before = {d["track_id"] for d in results[0]}
+                ids_after = {d["track_id"] for d in results[6]}
+                assert ids_before.isdisjoint(ids_after)
+            finally:
+                mgr.close()
+
+    def test_slow_drift_converges_via_reference_frame(self):
+        # Per-frame delta (1.0) is under the threshold, but the diff is
+        # taken against the last DISPATCHED frame, so drift accumulates
+        # and must eventually force a real pass.
+        frames = [_frame(50 + i) for i in range(8)]
+        with make_server() as srv:
+            mgr = StreamManager(srv, StreamConfig(delta_threshold=2.5))
+            try:
+                sid = mgr.open_stream()["session"]
+                _submit_all(mgr, sid, frames)
+                status = mgr.status()
+                assert status["cache_hits"] >= 2
+                assert status["cache_misses"] >= 3  # drift kept re-crossing
+            finally:
+                mgr.close()
+
+    def test_threshold_zero_disables_cache(self):
+        with make_server() as srv:
+            mgr = StreamManager(srv, StreamConfig(delta_threshold=0.0))
+            try:
+                sid = mgr.open_stream()["session"]
+                futs = [
+                    mgr.submit_frame(sid, i, _frame(50)) for i in range(3)
+                ]
+                [f.result(timeout=30) for f in futs]
+                assert not any(f.cache_hit for f in futs)
+                assert mgr.status()["cache_hits"] == 0
+            finally:
+                mgr.close()
+
+
+# ---- mixed long-lived + one-shot clients (the SlotPool satellite) --------
+
+
+class TestMixedClients:
+    def test_streams_and_singles_share_the_pool_without_starvation(self):
+        engine = StubDetectEngine(batch_sizes=(4,), video=True, delay_s=0.01)
+        with make_server(engine, max_delay_ms=5) as srv:
+            mgr = StreamManager(srv, StreamConfig(delta_threshold=2.0))
+            try:
+                n_frames, n_singles = 24, 12
+                frames = drift_frames(seed=1, n=n_frames, step=1.0,
+                                      cut_every=8)
+                stream_out: dict = {}
+                errors: list[BaseException] = []
+
+                # watchdog: test-local load generator, joined below.
+                def stream_client():
+                    try:
+                        sid = mgr.open_stream()["session"]
+                        stream_out["results"] = _submit_all(mgr, sid, frames)
+                        stream_out["stats"] = mgr.close_stream(sid)
+                    except BaseException as exc:
+                        errors.append(exc)
+
+                t = threading.Thread(target=stream_client, daemon=True)
+                t.start()
+                singles = [
+                    srv.submit(_frame(40 + i)) for i in range(n_singles)
+                ]
+                single_results = [f.result(timeout=60) for f in singles]
+                t.join(timeout=60)
+                assert not t.is_alive() and not errors
+                # Neither class starved: every frame AND every one-shot
+                # resolved.
+                assert len(stream_out["results"]) == n_frames
+                assert stream_out["stats"]["frames"] == n_frames
+                assert len(single_results) == n_singles
+                assert all(single_results)
+                # In-order per-stream release: frame i's tracks can only
+                # use ids minted by frames ≤ i (monotonic mint order).
+                max_seen = -1
+                for dets in stream_out["results"]:
+                    ids = [d["track_id"] for d in dets]
+                    assert ids, "video stub always yields boxes"
+                    max_seen = max(max_seen, max(ids))
+                    assert max(ids) <= max_seen
+            finally:
+                mgr.close()
+
+
+# ---- bit-identity with the cache off (PARITY §5.19) ----------------------
+
+
+class TestBitIdentity:
+    def test_stream_cache_off_matches_single_image_path_stub(self):
+        frames = drift_frames(seed=11, n=8, step=3.0, cut_every=3)
+        with make_server() as srv:
+            single = [srv.submit(fr).result(timeout=30) for fr in frames]
+            mgr = StreamManager(srv, StreamConfig(delta_threshold=0.0))
+            try:
+                sid = mgr.open_stream()["session"]
+                streamed = _submit_all(mgr, sid, frames)
+            finally:
+                mgr.close()
+        # track_id is the ONLY field stitching adds; stripped, the
+        # payloads are byte-identical.
+        assert [_strip(d) for d in streamed] == single
+
+    def test_stream_cache_off_bit_identical_live_model(
+        self, tiny_model_and_state
+    ):
+        """PARITY §5.19 on the real compiled path: an uncacheable stream
+        (delta_threshold 0) over the live tiny model serves exactly what
+        sequential single-image submission serves — same program, same
+        resize, same conversion; score_threshold 0.001 keeps the oracle
+        non-vacuous on the untrained head."""
+        from batchai_retinanet_horovod_coco_tpu.evaluate.detect import (
+            DetectConfig,
+        )
+        from batchai_retinanet_horovod_coco_tpu.serve import DetectEngine
+
+        model, state = tiny_model_and_state
+        cfg = DetectConfig(
+            score_threshold=0.001, pre_nms_size=64, max_detections=10
+        )
+        engine = DetectEngine.from_state(
+            model, state, buckets=((64, 64),), batch_sizes=(2,), config=cfg,
+            min_side=64, max_side=64,
+        )
+        frames = drift_frames(seed=5, n=4, step=8.0, cut_every=2)
+        with DetectionServer(
+            engine, ServeConfig(max_delay_ms=50, preprocess_workers=1)
+        ) as srv:
+            single = [srv.submit(fr).result(timeout=120) for fr in frames]
+            assert any(single), "no detections anywhere (vacuous parity)"
+            mgr = StreamManager(srv, StreamConfig(delta_threshold=0.0))
+            try:
+                sid = mgr.open_stream(width=64, height=64)["session"]
+                streamed = _submit_all(mgr, sid, frames, timeout_s=120.0)
+            finally:
+                mgr.close()
+        assert [_strip(d) for d in streamed] == single
+
+
+# ---- fleet session affinity ----------------------------------------------
+
+
+EXACT_BACKOFF = BackoffPolicy(
+    max_tries=1_000_000, base_s=1.0, multiplier=2.0, ceiling_s=8.0,
+    jitter=0.0,
+)
+
+
+class _SinkSpy:
+    def __init__(self):
+        self.events: list[tuple[str, dict]] = []
+
+    def event(self, kind: str, **fields) -> None:
+        self.events.append((kind, fields))
+
+
+def _make_fleet(n=2, sink=None):
+    servers = [
+        DetectionServer(
+            StubDetectEngine(video=True),
+            ServeConfig(max_delay_ms=5, preprocess_workers=1),
+            replica_id=f"r{k}",  # in-process replicas share host-pid
+        )
+        for k in range(n)
+    ]
+    router = FleetRouter(
+        [LocalReplica(s) for s in servers],
+        FleetConfig(probe_backoff=EXACT_BACKOFF, poll_interval_s=0.05),
+        sink=sink,
+        auto_poll=False,
+    )
+    return router, servers
+
+
+class TestFleetAffinity:
+    def test_frames_route_to_pinned_replica(self):
+        router, servers = _make_fleet()
+        try:
+            opened = router.stream_open(width=64, height=64)
+            sid = opened["session"]
+            for seq in range(6):
+                dets, _hit = router.stream_frame(sid, seq, _frame(50))
+                assert dets
+            # Every frame landed on the pinned replica's stream manager;
+            # the other replica never saw a session (LocalReplica exposes
+            # the lazily-created manager).
+            frames_by_replica = {
+                st.replica.replica_id:
+                    st.replica.stream_manager.status()["frames"]
+                for st in router._states
+            }
+            assert frames_by_replica[opened["replica_id"]] == 6
+            others = [
+                v for k, v in frames_by_replica.items()
+                if k != opened["replica_id"]
+            ]
+            assert all(v == 0 for v in others)
+            router.stream_close(sid)
+        finally:
+            router.close()
+            for s in servers:
+                s.close()
+
+    def test_replica_death_repins_once_with_zero_dropped_frames(self):
+        sink = _SinkSpy()
+        router, servers = _make_fleet(sink=sink)
+        try:
+            opened = router.stream_open(width=64, height=64)
+            sid = opened["session"]
+            results = []
+            for seq in range(10):
+                dets, _hit = router.stream_frame(sid, seq, _frame(60))
+                results.append(dets)
+            # Kill the pinned replica mid-stream and let the poller open
+            # its breaker.
+            by_id = {s.replica_id: s for s in servers}
+            by_id[opened["replica_id"]].close()
+            router.poll_once(now=100.0)
+            # Every subsequent frame still serves: the router re-pins to
+            # the survivor and re-opens a backend session there.
+            for seq in range(10, 20):
+                dets, _hit = router.stream_frame(sid, seq, _frame(60))
+                results.append(dets)
+            assert len(results) == 20 and all(results)
+            repins = [e for e in sink.events if e[0] == "stream_repinned"]
+            assert len(repins) == 1
+            assert repins[0][1]["stream"] == sid
+            assert repins[0][1]["to_replica"] != opened["replica_id"]
+            assert router.status()["stream_repins"] == 1
+        finally:
+            router.close()
+            for s in servers:
+                s.close()
+
+
+# ---- seeded arrival schedules (the shared bench helper) ------------------
+
+
+class TestArrivals:
+    def test_mixed_schedule_deterministic_per_seed(self):
+        a = mixed_arrival_schedule(64, base_rate=50.0, seed=3)
+        b = mixed_arrival_schedule(64, base_rate=50.0, seed=3)
+        assert a == b  # byte-identical, not merely close
+        assert a != mixed_arrival_schedule(64, base_rate=50.0, seed=4)
+        assert all(t1 > t0 for t0, t1 in zip(a, a[1:]))
+
+    def test_multi_stream_schedule_deterministic_and_ordered(self):
+        a = multi_stream_schedule(3, 20, fps=30.0, seed=9)
+        b = multi_stream_schedule(3, 20, fps=30.0, seed=9)
+        assert a == b
+        assert a != multi_stream_schedule(3, 20, fps=30.0, seed=10)
+        for times in a:
+            assert len(times) == 20
+            assert times == sorted(times)
+            assert all(t >= 0.0 for t in times)
+
+
+# ---- pipelined-admission races (REVIEW regressions) ----------------------
+
+
+def _stalling_frame(value, started, release, hw=(64, 64)):
+    """A frame whose ``astype`` blocks until ``release`` — pins the
+    submitting thread inside ``_admit``'s delta computation, AFTER its
+    seq is consumed but BEFORE its entry reaches the delivery queue, so
+    tests can interleave a later frame (or the reaper) deterministically
+    in that window."""
+
+    class _Stalling(np.ndarray):
+        def astype(self, *args, **kwargs):
+            started.set()
+            release.wait(10.0)
+            return np.asarray(self).astype(*args, **kwargs)
+
+    return _frame(value, hw).view(_Stalling)
+
+
+class TestPipelinedAdmission:
+    def test_cache_hit_never_overtakes_frame_still_in_admission(self):
+        """A pipelined cache hit (frame 2) finishing admission while the
+        previous frame (1) is still mid-``_admit`` must NOT jump the
+        delivery queue: strict per-stream order, and the hit's payload is
+        the frame-1 miss's detections, not stale frame-0 ones."""
+        with make_server() as srv:
+            mgr = StreamManager(srv, StreamConfig(delta_threshold=2.0))
+            started, release = threading.Event(), threading.Event()
+            try:
+                sid = mgr.open_stream()["session"]
+                mgr.submit_frame(sid, 0, _frame(50)).result(timeout=30)
+                holder: dict = {}
+
+                # watchdog: test-local submitter, joined below.
+                def submit_stalled():
+                    try:
+                        holder["fut"] = mgr.submit_frame(
+                            sid, 1, _stalling_frame(80, started, release)
+                        )
+                    except BaseException as exc:
+                        holder["err"] = exc
+
+                t = threading.Thread(target=submit_stalled, daemon=True)
+                t.start()
+                assert started.wait(10.0)
+                # Frame 2: pixel-identical to frame 0's reference → an
+                # immediate cache hit, admitted while frame 1 stalls.
+                f2 = mgr.submit_frame(sid, 2, _frame(50))
+                assert f2.cache_hit
+                time.sleep(0.1)
+                assert not f2.done(), "hit delivered ahead of frame 1"
+                release.set()
+                t.join(timeout=10)
+                assert "err" not in holder
+                f1 = holder["fut"]
+                d1 = f1.result(timeout=30)
+                d2 = f2.result(timeout=30)
+                assert not f1.cache_hit
+                # In-order delivery means the hit serves the most recent
+                # MISS's detections (frame 1's), not frame 0's.
+                assert d2 == d1
+            finally:
+                release.set()
+                mgr.close()
+
+    def test_reaper_defers_while_admission_in_progress(self):
+        """A session that LOOKS idle (empty queue, stale last_active) but
+        has a frame mid-admission must not be reaped out from under the
+        submit — pre-fix the slipped entry's future hung forever."""
+        clock = [0.0]
+        with make_server() as srv:
+            mgr = StreamManager(
+                srv,
+                StreamConfig(delta_threshold=2.0, idle_timeout_s=5.0),
+                now_fn=lambda: clock[0],
+            )
+            started, release = threading.Event(), threading.Event()
+            try:
+                sid = mgr.open_stream()["session"]
+                mgr.submit_frame(sid, 0, _frame(50)).result(timeout=30)
+                holder: dict = {}
+
+                # watchdog: test-local submitter, joined below.
+                def submit_stalled():
+                    try:
+                        holder["fut"] = mgr.submit_frame(
+                            sid, 1, _stalling_frame(80, started, release)
+                        )
+                    except BaseException as exc:
+                        holder["err"] = exc
+
+                t = threading.Thread(target=submit_stalled, daemon=True)
+                t.start()
+                assert started.wait(10.0)
+                clock[0] = 10.0  # idle_timeout_s exceeded mid-admission
+                assert mgr.reap_idle() == []
+                assert sid in mgr.status()["streams"]
+                release.set()
+                t.join(timeout=10)
+                assert "err" not in holder
+                assert holder["fut"].result(timeout=10)
+                # With the admission finished the session reaps normally
+                # (the delivery thread races the explicit call).
+                clock[0] = 20.0
+                mgr.reap_idle()
+                deadline = time.monotonic() + 5.0
+                while sid in mgr.status()["streams"]:
+                    assert time.monotonic() < deadline, "never reaped"
+                    time.sleep(0.01)
+                with pytest.raises(RequestRejected) as ei:
+                    mgr.submit_frame(sid, 2, _frame(50))
+                assert ei.value.reason == "unknown_stream"
+            finally:
+                release.set()
+                mgr.close()
+
+
+# ---- fleet edge/backend seq lockstep (REVIEW regressions) ----------------
+
+
+class TestFleetSeqLockstep:
+    def test_post_admission_shed_does_not_wedge_stream(self):
+        """decode_error is raised AFTER the backend consumed the frame's
+        seq: the edge must advance its backend_seq in lockstep — pre-fix
+        every later frame shed ``stream_out_of_order`` forever."""
+        router, servers = _make_fleet()
+        try:
+            sid = router.stream_open(width=64, height=64)["session"]
+            dets, _hit = router.stream_frame(sid, 0, _frame(50))
+            assert dets
+            with pytest.raises(RequestRejected) as ei:
+                router.stream_frame(sid, 1, b"not an image")
+            assert ei.value.reason == "decode_error"
+            for seq in range(2, 6):
+                dets, _hit = router.stream_frame(sid, seq, _frame(50))
+                assert dets
+        finally:
+            router.close()
+            for s in servers:
+                s.close()
+
+    def test_seq_drift_resyncs_by_reopening_backend_session(self):
+        """Residual edge/backend seq drift (an ambiguous transport
+        timeout) surfaces as a backend ``stream_out_of_order`` — the edge
+        treats it as a resync signal and re-opens the backend session on
+        the same replica instead of wedging the stream."""
+        router, servers = _make_fleet()
+        try:
+            sid = router.stream_open(width=64, height=64)["session"]
+            for seq in range(3):
+                dets, _hit = router.stream_frame(sid, seq, _frame(60))
+                assert dets
+            with router._lock:
+                pin = router._streams[sid]
+            pin.backend_seq -= 1  # edge now one behind the backend
+            for seq in range(3, 8):
+                dets, _hit = router.stream_frame(sid, seq, _frame(60))
+                assert dets
+        finally:
+            router.close()
+            for s in servers:
+                s.close()
+
+
+# ---- HTTP stream header hardening (REVIEW regression) --------------------
+
+
+class TestHttpStreamHeaders:
+    def test_malformed_frame_header_is_400_not_dropped_connection(self):
+        from batchai_retinanet_horovod_coco_tpu.serve import serve_http
+
+        from PIL import Image
+
+        buf = io.BytesIO()
+        Image.fromarray(_frame(50)).save(buf, "PNG")
+        png = buf.getvalue()
+        pre_existing = {
+            t for t in threading.enumerate()
+            if t.name == "serve-stream-delivery"
+        }
+        with make_server() as srv:
+            httpd = serve_http(srv)
+            t = threading.Thread(target=httpd.serve_forever, daemon=True)
+            t.start()
+            host, port = httpd.server_address
+            base = f"http://{host}:{port}"
+            try:
+                req = urllib.request.Request(
+                    f"{base}/stream/open", data=b"{}", method="POST"
+                )
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    sid = json.load(r)["session"]
+                req = urllib.request.Request(
+                    f"{base}/stream/frame", data=png, method="POST",
+                    headers={
+                        "X-Retinanet-Stream": sid,
+                        "X-Retinanet-Frame": "not-a-number",
+                    },
+                )
+                with pytest.raises(urllib.error.HTTPError) as ei:
+                    urllib.request.urlopen(req, timeout=30)
+                assert ei.value.code == 400
+                assert json.load(ei.value)["reason"] == "decode_error"
+                # The session survived the bad request: frame 0 serves.
+                req = urllib.request.Request(
+                    f"{base}/stream/frame", data=png, method="POST",
+                    headers={
+                        "X-Retinanet-Stream": sid,
+                        "X-Retinanet-Frame": "0",
+                    },
+                )
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    assert r.status == 200
+                    out = json.load(r)
+                    assert out["frame"] == 0 and out["detections"]
+            finally:
+                httpd.shutdown()
+                httpd.server_close()
+        # server_close() owns the stream manager: no delivery thread may
+        # outlive the standard shutdown()/server_close() teardown.
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            leaked = [
+                t for t in threading.enumerate()
+                if t.name == "serve-stream-delivery" and t.is_alive()
+                and t not in pre_existing
+            ]
+            if not leaked:
+                break
+            time.sleep(0.05)
+        assert not leaked

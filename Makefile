@@ -24,7 +24,8 @@ CLUSTER = python -m batchai_retinanet_horovod_coco_tpu.launch.cluster
 	convergence-full lint lint-obs check-static tune-smoke tunebench \
 	tunebench-check perf-report perf-report-check telemetry-smoke \
 	numerics-smoke chaos chaos-smoke chaos-comm ckptbench \
-	ckptbench-check fleet-smoke fleet-obs-smoke stream-smoke commbench \
+	ckptbench-check fleet-smoke fleet-obs-smoke stream-smoke scale-smoke \
+	commbench \
 	commbench-check
 
 create:
@@ -220,6 +221,17 @@ fleet-obs-smoke:
 stream-smoke:
 	JAX_PLATFORMS=cpu python scripts/stream_smoke.py
 
+# Autoscaling smoke (ISSUE 19, scripts/chaos.py --autoscale): the seeded
+# diurnal/spike day against a real 1..3 autoscaling stub fleet — the
+# spike must scale 1→N (a mid-spike SIGKILL is repaired through the
+# respawn budget), the quiet tail must scale back to 1, and every
+# request resolves (zero hangs, zero silent drops); then the cold tier:
+# an idle min_replicas=0 fleet reaches ZERO replicas and the first
+# request's shed (demand_scale_from_zero) respawns capacity so the
+# client's retry lands.  CPU-only, no dataset — wired into check-static.
+scale-smoke:
+	JAX_PLATFORMS=cpu python scripts/chaos.py --autoscale
+
 # CKPTBENCH (ISSUE 11): the two durability numbers — async-save overhead
 # (wall of N checkpointed steps vs the same N without) and resume
 # time-to-first-step — committed as CKPTBENCH.json.  ckptbench-check
@@ -238,8 +250,8 @@ ckptbench-check:
 # run without touching an accelerator (chaos-smoke DOES run a few real
 # CPU training subprocesses over generated synthetic data — budget the
 # job for minutes, not seconds).
-check-static: lint telemetry-smoke numerics-smoke chaos-smoke fleet-smoke fleet-obs-smoke stream-smoke
-	@echo "check-static: lint engine + watchdog audit + HLO collective audit + telemetry smoke + numerics smoke + chaos smoke + fleet smoke + fleet obs smoke + stream smoke all green"
+check-static: lint telemetry-smoke numerics-smoke chaos-smoke fleet-smoke fleet-obs-smoke stream-smoke scale-smoke
+	@echo "check-static: lint engine + watchdog audit + HLO collective audit + telemetry smoke + numerics smoke + chaos smoke + fleet smoke + fleet obs smoke + stream smoke + scale smoke all green"
 
 # Static watchdog-coverage audit alone (ISSUE 3; now a shim over the lint
 # engine's watchdog-coverage rule — same CLI, same exit codes).  Also runs

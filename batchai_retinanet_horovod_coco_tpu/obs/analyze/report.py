@@ -1164,6 +1164,14 @@ _FLEET_EVENT_NAMES = (
     "fleet_replica_died",
     "fleet_replica_respawned",
     "fleet_respawn_failed",
+    # Autoscaling control plane (ISSUE 19).
+    "autoscaler_armed",
+    "autoscale_decision",
+    "autoscale_launch_failed",
+    "respawn_budget_exhausted",
+    "fleet_replica_joined",
+    "fleet_replica_draining",
+    "fleet_replica_removed",
 )
 
 # Serve stage-span families attributed per replica process track.
@@ -1312,7 +1320,10 @@ def _fleet_section(
             event_counts.setdefault(rid, {})
             event_counts[rid][name] = event_counts[rid].get(name, 0) + 1
             item = {"t_s": _r(_start_s(e), 3), "event": name}
-            for k in ("replica_id", "reason", "trace", "rc", "rule"):
+            for k in (
+                "replica_id", "reason", "trace", "rc", "rule",
+                "decision", "delta",
+            ):
                 if args.get(k) is not None:
                     item[k] = args[k]
             timeline.append(item)
@@ -1370,6 +1381,43 @@ def _fleet_bottlenecks(fleet: dict) -> list[dict]:
                     "follow this replica's track in the merged trace "
                     "around the breaker-open instants; the re-dispatch "
                     "markers carry the affected trace ids"
+                ),
+                "tune_ops": [],
+            }
+        )
+    # Underprovisioned fleet (ISSUE 19): scale-up breaches the policy
+    # could NOT act on because the fleet was already at max_replicas —
+    # the capped autoscale_decision instants are the evidence trail.
+    decisions = [
+        it for it in fleet.get("timeline") or []
+        if it.get("event") == "autoscale_decision"
+    ]
+    capped = [
+        it for it in decisions if it.get("decision") == "scale_up_capped"
+    ]
+    if capped:
+        ups = sum(
+            1 for it in decisions if it.get("decision") == "scale_up"
+        )
+        reasons = sorted({str(it.get("reason")) for it in capped})
+        cands.append(
+            {
+                "name": "fleet:underprovisioned",
+                "score": _r(
+                    min(1.0, len(capped) / max(1.0, len(capped) + ups))
+                ),
+                "spans": ["serve_request"],
+                "evidence": (
+                    f"{len(capped)} scale-up breach(es) "
+                    f"({', '.join(reasons)}) blocked at max_replicas "
+                    f"vs {ups} executed scale-up(s) — demand outgrew "
+                    "the replica ceiling"
+                ),
+                "suggestion": (
+                    "raise max_replicas (or per-replica slot capacity) "
+                    "in the autoscale policy; each capped "
+                    "autoscale_decision on the timeline carries the "
+                    "breached signal values"
                 ),
                 "tune_ops": [],
             }

@@ -491,6 +491,35 @@ def fleet_availability_rule(
     )
 
 
+def fleet_occupancy_rule(
+    ceiling: float = 0.97,
+    metric: str = "fleet_occupancy",
+    for_s: float = 30.0,
+) -> SloRule:
+    """Fleet saturation floor-to-ceiling tripwire (ISSUE 19): fires
+    when mean live slot occupancy across ROUTABLE replicas (the fleet
+    router's ``fleet_occupancy`` gauge — draining replicas excluded)
+    stays pinned at ``ceiling`` for ``for_s``.  With the autoscaler
+    armed this can only sustain when scale-ups are capped at
+    ``max_replicas`` — i.e. the fleet is underprovisioned BY POLICY,
+    which is a page, not a scale decision; without the autoscaler it is
+    the "arm --autoscale or add replicas" signal.  Silent on registries
+    without the gauge (single-replica serve, idle fleets), so it is
+    safe to arm wherever the fleet monitor runs."""
+    return SloRule(
+        name="fleet-occupancy-saturated",
+        metric=metric,
+        op=">=",
+        threshold=ceiling,
+        for_s=for_s,
+        description=(
+            f"fleet slot occupancy pinned at >= {ceiling} for {for_s:g}s "
+            "(capacity saturated; autoscale capped or not armed — see "
+            "the autoscale_decision events and fleet_scale_capped_total)"
+        ),
+    )
+
+
 def ef_residual_spike(
     factor: float = 10.0,
     window: int = 32,

@@ -327,6 +327,16 @@ class Registry:
         with self._lock:
             self._collectors.append(fn)
 
+    def unregister_collector(
+        self, fn: Callable[[], Iterable[CollectorSample]]
+    ) -> None:
+        """Drop a registered collector (identity match).  A component
+        with a shorter lifetime than the registry it reports into (the
+        fleet autoscaler on the router's registry, ISSUE 19) must detach
+        on stop, or its gauges outlive it as frozen lies."""
+        with self._lock:
+            self._collectors = [c for c in self._collectors if c is not fn]
+
     def unregister(self, name: str) -> None:
         with self._lock:
             self._metrics.pop(name, None)

@@ -32,6 +32,11 @@ Layers (one module each; RUNBOOK §10 is the operator guide):
   track stitching, and the frame-delta result cache
 """
 
+from batchai_retinanet_horovod_coco_tpu.serve.autoscale import (
+    AutoscalePolicy,
+    Autoscaler,
+    LocalLauncher,
+)
 from batchai_retinanet_horovod_coco_tpu.serve.common import (
     DetectionFuture,
     LatencyStats,
@@ -64,6 +69,8 @@ from batchai_retinanet_horovod_coco_tpu.serve.stream import (
 )
 
 __all__ = [
+    "AutoscalePolicy",
+    "Autoscaler",
     "DetectEngine",
     "DetectionServer",
     "DetectionFuture",
@@ -71,6 +78,7 @@ __all__ = [
     "FleetRouter",
     "HttpReplica",
     "LatencyStats",
+    "LocalLauncher",
     "LocalReplica",
     "ReplicaUnavailable",
     "RequestRejected",

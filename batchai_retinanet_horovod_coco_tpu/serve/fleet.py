@@ -878,6 +878,66 @@ class FleetRouter:
         for sid in stale:
             self._emit_event("fleet_stream_reaped", stream=sid)
 
+    # ---- elastic membership (ISSUE 19) -----------------------------------
+
+    def active_replica_count(self) -> int:
+        """Non-drained replicas — the autoscaler's notion of capacity
+        (a draining victim already stopped counting)."""
+        with self._lock:
+            return sum(1 for st in self._states if st.state != DRAINED)
+
+    def add_replica(self, replica) -> None:
+        """Admit a new replica at weight ZERO: it takes traffic only
+        after its first successful health poll populates its load fields
+        — the same admission a half-open probe applies to a readmitted
+        replica, so a sick spawn never takes weight (ISSUE 19)."""
+        st = _ReplicaState(replica)
+        with self._lock:
+            self._states.append(st)
+        self._emit_event(
+            "fleet_replica_joined",
+            replica_id=replica.replica_id,
+            version=getattr(replica, "version", "unknown"),
+        )
+
+    def begin_drain(self, replica_id: str) -> bool:
+        """Administratively drain one replica (the scale-down path): no
+        new traffic routes to it, pinned streams re-pin on their next
+        frame, and it drops out of the occupancy aggregates AND the
+        federated view immediately — capacity being reclaimed must never
+        be double-counted by the control loop (ISSUE 19)."""
+        with self._lock:
+            st = next(
+                (s for s in self._states
+                 if s.replica.replica_id == replica_id), None,
+            )
+            if st is None or st.state == DRAINED:
+                return False
+            st.state = DRAINED
+            st.weight = 0.0
+            self._federated.pop(replica_id, None)
+        self._emit_event("fleet_replica_draining", replica_id=replica_id)
+        self._recompute_weights()
+        return True
+
+    def remove_replica(self, replica_id: str) -> bool:
+        """Forget a replica entirely (drain finished, or the respawn
+        budget abandoned its slot)."""
+        with self._lock:
+            st = next(
+                (s for s in self._states
+                 if s.replica.replica_id == replica_id), None,
+            )
+            if st is None:
+                return False
+            self._states.remove(st)
+            self._federated.pop(replica_id, None)
+            if self._canary is st:
+                self._canary = None
+        self._emit_event("fleet_replica_removed", replica_id=replica_id)
+        self._recompute_weights()
+        return True
+
     # ---- metrics federation (ISSUE 15) -----------------------------------
 
     def scrape_metrics_once(self) -> None:
@@ -1242,6 +1302,33 @@ class FleetRouter:
                    "whole fleet is healthy; the availability-floor SLO "
                    "rule watches this)", None,
                    round(closed / len(active), 4))
+        # Fleet occupancy aggregates (ISSUE 19): the autoscaler's primary
+        # signal, from the health-poll advertised slot fields of CLOSED
+        # accepting replicas ONLY — a draining or broken replica's
+        # capacity is already being reclaimed and must not be counted.
+        occ: list[float] = []
+        free_total = 0.0
+        for rid, state, weight, load, is_canary in states:
+            if state != CLOSED or not load.get("accepting", False):
+                continue
+            cap = float(load.get("slot_capacity") or 0)
+            if cap <= 0:
+                continue
+            free = float(load.get("free_slots") or 0)
+            inflight_r = float(load.get("inflight") or 0)
+            # Claimed device slots OR queued backlog, whichever reads
+            # fuller — idle = 0.0, saturated = 1.0.
+            occ.append(min(1.0, max((cap - free) / cap, inflight_r / cap)))
+            free_total += free
+        if occ:
+            yield ("fleet_occupancy", "gauge",
+                   "mean live slot occupancy across routable replicas "
+                   "(draining replicas excluded; the autoscale band "
+                   "signal)", None,
+                   round(sum(occ) / len(occ), 4))
+            yield ("fleet_free_slots", "gauge",
+                   "idle device slots across routable replicas", None,
+                   free_total)
         for rid, state, weight, load, is_canary in states:
             yield ("fleet_replica_weight", "gauge",
                    "routing weight from advertised load fields",
@@ -1249,6 +1336,10 @@ class FleetRouter:
             yield ("fleet_breaker_state", "gauge",
                    "0=closed 1=half_open 2=open 3=drained",
                    {"replica": rid}, _STATE_CODE[state])
+            yield ("fleet_replica_draining", "gauge",
+                   "1 while this replica is administratively drained "
+                   "(scale-down victim or rolled-back canary)",
+                   {"replica": rid}, 1.0 if state == DRAINED else 0.0)
             if load.get("p99_ms"):
                 yield ("fleet_replica_p99_ms", "gauge",
                        "replica-advertised windowed p99",
@@ -1573,6 +1664,74 @@ def serve_fleet_http(
 # ---------------------------------------------------------------------------
 
 
+class _SubprocessLauncher:
+    """The fleet CLI's autoscale actuator (serve/autoscale.py launcher
+    protocol) over the CLI's spawn/supervision machinery: ``launch``
+    forks one more serve-CLI replica through the SAME ``spawn_one``
+    path the startup fleet uses (so it is supervised and budget-bounded
+    like any other slot), ``terminate`` removes the victim from the
+    supervised set FIRST (the supervisor must not respawn an
+    intentional scale-down) and SIGTERMs it into the serve frontend's
+    bounded drain, and ``reap`` reports the process gone — escalating
+    to SIGKILL only past ``kill_after_s``, so a wedged drain cannot
+    pin a reclaiming slot forever."""
+
+    def __init__(self, spawn_fn, procs: dict, abandoned: set,
+                 kill_after_s: float = 30.0):
+        self._spawn = spawn_fn  # (rid) -> replica; registers in procs
+        self._procs = procs
+        self._abandoned = abandoned
+        self._kill_after_s = kill_after_s
+        self._seq = 0
+        self._terminated: dict[str, tuple] = {}  # rid -> (proc, t0)
+
+    def launch(self):
+        rid = f"scale-{self._seq}"
+        self._seq += 1
+        return self._spawn(rid)
+
+    def owns(self, rid: str) -> bool:
+        return rid in self._procs
+
+    def terminate(self, rid: str) -> None:
+        rec = self._procs.pop(rid, None)
+        if rec is None:
+            return
+        proc = rec[0]
+        if proc.poll() is None:
+            proc.terminate()  # SIGTERM -> the serve CLI's drain path
+        self._terminated[rid] = (proc, monotonic_s())
+
+    def reap(self, rid: str) -> bool:
+        rec = self._terminated.get(rid)
+        if rec is None:
+            return True
+        proc, t0 = rec
+        if proc.poll() is None:
+            if monotonic_s() - t0 > self._kill_after_s:
+                proc.kill()
+            return False
+        self._terminated.pop(rid, None)
+        return True
+
+    def prune(self) -> list[str]:
+        out = sorted(self._abandoned)
+        for rid in out:
+            self._abandoned.discard(rid)
+        return out
+
+    def close(self) -> None:
+        """Teardown: make sure no terminated-but-straggling child
+        outlives the CLI (drain already had its bounded chance)."""
+        from batchai_retinanet_horovod_coco_tpu.serve.replica import (
+            release_subprocess,
+        )
+
+        for rid, (proc, _t0) in list(self._terminated.items()):
+            release_subprocess(proc, sigterm_timeout_s=5.0)
+            self._terminated.pop(rid, None)
+
+
 def build_parser():
     import argparse
 
@@ -1605,6 +1764,46 @@ def build_parser():
     p.add_argument("--no-respawn", action="store_true",
                    help="do not respawn dead spawned replicas")
     p.add_argument("--respawn-delay-s", type=float, default=1.0)
+    p.add_argument("--respawn-budget", type=int, default=5,
+                   help="respawns allowed per replica slot before the "
+                        "supervisor gives up (deterministic-jitter "
+                        "backoff between attempts; an exhausted slot "
+                        "emits respawn_budget_exhausted once and is "
+                        "left to the autoscaler)")
+    # Autoscaling (ISSUE 19): a declarative policy evaluated by the
+    # serve/autoscale.py control loop over the federated fleet signals.
+    p.add_argument("--autoscale", action="store_true",
+                   help="arm the autoscaler: scale spawned replicas "
+                        "between --min-replicas and --max-replicas to "
+                        "hold --target-occupancy")
+    p.add_argument("--target-occupancy", default="0.25:0.75",
+                   metavar="LOW:HIGH",
+                   help="occupancy hysteresis band: scale up above "
+                        "HIGH, down below LOW, never inside the band")
+    p.add_argument("--min-replicas", type=int, default=1,
+                   help="autoscale floor (0 = scale-to-zero: an idle "
+                        "fleet drains every replica and respawns on "
+                        "the first request)")
+    p.add_argument("--max-replicas", type=int, default=4,
+                   help="autoscale ceiling (a sustained breach at the "
+                        "ceiling emits capped decisions — the "
+                        "fleet:underprovisioned signal)")
+    p.add_argument("--autoscale-policy", default=None, metavar="FILE",
+                   help="JSON AutoscalePolicy file; overrides the "
+                        "individual autoscale flags entirely")
+    p.add_argument("--autoscale-for-s", type=float, default=5.0,
+                   help="a band breach must sustain this long before "
+                        "any scale decision fires")
+    p.add_argument("--autoscale-up-cooldown-s", type=float, default=10.0)
+    p.add_argument("--autoscale-down-cooldown-s", type=float,
+                   default=30.0)
+    p.add_argument("--autoscale-interval-s", type=float, default=None,
+                   help="autoscaler poll cadence (default: "
+                        "--poll-interval)")
+    p.add_argument("--autoscale-p99-slo-ms", type=float, default=None,
+                   help="optional federated-p99 ceiling: a sustained "
+                        "breach scales up even inside the occupancy "
+                        "band")
     p.add_argument("--poll-interval", type=float, default=1.0,
                    help="health-poll cadence (seconds)")
     p.add_argument("--fleet-timeout-s", type=float, default=30.0,
@@ -1740,6 +1939,7 @@ def main(argv: list[str] | None = None) -> dict:
                     if args.availability_floor is not None
                     else 0.999
                 ),
+                slo_lib.fleet_occupancy_rule(),
                 slo_lib.stall_rule(),
             ]
             + [slo_lib.parse_rule(s) for s in (args.slo_rule or [])],
@@ -1776,20 +1976,75 @@ def main(argv: list[str] | None = None) -> dict:
         router.add_canary(canary, start_monitor=True)
 
     stop_supervising = threading.Event()
+    # Respawn supervision state (ISSUE 19): per-slot budgets, the slots
+    # waiting out a backoff delay, and the slots the budget abandoned —
+    # shared with the autoscaler's launcher, which prunes abandoned
+    # slots out of the router.
+    from batchai_retinanet_horovod_coco_tpu.serve.replica import (
+        RespawnBudget,
+    )
+
+    budgets: dict[str, RespawnBudget] = {}
+    waiting: dict[str, int] = {}  # rid -> pinned port
+    abandoned: set[str] = set()
+
+    def budget_for(rid: str) -> RespawnBudget:
+        b = budgets.get(rid)
+        if b is None:
+            # Deterministic per-slot jitter (the breaker's seeding
+            # pattern): reproducible schedules, decorrelated slots.
+            b = RespawnBudget(BackoffPolicy(
+                max_tries=max(1, args.respawn_budget),
+                base_s=args.respawn_delay_s,
+                multiplier=2.0,
+                ceiling_s=30.0,
+                jitter=0.1,
+                seed=zlib.crc32(rid.encode()),
+            ))
+            budgets[rid] = b
+        return b
+
+    def note_death(rid: str, port: int, now: float) -> None:
+        if budget_for(rid).note_death(now):
+            waiting[rid] = port
+        else:
+            abandoned.add(rid)
+            emit(
+                "respawn_budget_exhausted",
+                replica_id=rid, deaths=budgets[rid].deaths,
+            )
 
     def supervise(hb: watchdog.Heartbeat) -> None:
         """Respawn dead spawned replicas in place (same id, same port) so
-        the breaker's half-open probe readmits them."""
+        the breaker's half-open probe readmits them — BOUNDED by a
+        per-slot ``RespawnBudget`` (ISSUE 19): each death schedules the
+        next respawn on a deterministic-jitter backoff, and an exhausted
+        budget emits ``respawn_budget_exhausted`` exactly once and
+        leaves the slot to the autoscaler (a crash-looping spawn must
+        never be a tight loop)."""
         try:
             while not stop_supervising.wait(args.respawn_delay_s):
                 hb.beat()
+                now = monotonic_s()
                 for rid, (proc, port) in list(procs.items()):
                     if proc.poll() is None:
+                        b = budgets.get(rid)
+                        if b is not None:
+                            b.note_alive(now)
                         continue
+                    cur = procs.get(rid)
+                    if cur is None or cur[0] is not proc:
+                        continue  # scaled down / replaced under us
+                    procs.pop(rid, None)
                     emit(
                         "fleet_replica_died",
                         replica_id=rid, rc=proc.returncode,
                     )
+                    note_death(rid, port, now)
+                for rid, port in list(waiting.items()):
+                    if not budgets[rid].ready(now):
+                        continue
+                    waiting.pop(rid, None)
                     try:
                         new_proc, _rep = spawn_http_replica(
                             rid, port=port,
@@ -1805,6 +2060,7 @@ def main(argv: list[str] | None = None) -> dict:
                             "fleet_respawn_failed",
                             replica_id=rid, error=repr(exc),
                         )
+                        note_death(rid, port, monotonic_s())
                         continue
                     procs[rid] = (new_proc, port)
                     emit(
@@ -1828,6 +2084,47 @@ def main(argv: list[str] | None = None) -> dict:
             name="fleet-supervisor",
         )
         supervisor.start()
+
+    # Autoscaling (ISSUE 19): the declarative policy + the control loop
+    # over the federated signals, actuating through the SAME spawn and
+    # supervision machinery as everything above.
+    autoscaler = None
+    launcher = None
+    if args.autoscale:
+        from batchai_retinanet_horovod_coco_tpu.serve.autoscale import (
+            Autoscaler,
+            AutoscalePolicy,
+        )
+
+        if args.autoscale_policy:
+            policy = AutoscalePolicy.from_file(args.autoscale_policy)
+        else:
+            low, _, high = args.target_occupancy.partition(":")
+            policy = AutoscalePolicy(
+                min_replicas=args.min_replicas,
+                max_replicas=args.max_replicas,
+                occupancy_low=float(low),
+                occupancy_high=float(high or low),
+                p99_slo_ms=args.autoscale_p99_slo_ms,
+                for_s=args.autoscale_for_s,
+                up_cooldown_s=args.autoscale_up_cooldown_s,
+                down_cooldown_s=args.autoscale_down_cooldown_s,
+                interval_s=(
+                    args.autoscale_interval_s
+                    if args.autoscale_interval_s is not None
+                    else args.poll_interval
+                ),
+            )
+        launcher = _SubprocessLauncher(spawn_one, procs, abandoned)
+        autoscaler = Autoscaler(router, policy, launcher, sink=sink)
+        autoscaler.start()
+        emit(
+            "autoscaler_armed",
+            min_replicas=policy.min_replicas,
+            max_replicas=policy.max_replicas,
+            occupancy_band=[policy.occupancy_low, policy.occupancy_high],
+            p99_slo_ms=policy.p99_slo_ms,
+        )
 
     httpd = serve_fleet_http(
         router, args.host, args.http,
@@ -1875,6 +2172,10 @@ def main(argv: list[str] | None = None) -> dict:
                 )
         if slo_monitor is not None:
             slo_monitor.stop()
+        if autoscaler is not None:
+            autoscaler.stop()
+        if launcher is not None:
+            launcher.close()
         if status_server is not None:
             status_server.close()
         router.close()

@@ -413,6 +413,87 @@ class HttpReplica:
         pass
 
 
+class RespawnBudget:
+    """Budgeted respawn supervision for ONE replica slot (ISSUE 19).
+
+    The fleet CLI used to respawn a dead replica unconditionally every
+    supervision tick — a replica that dies instantly on spawn (bad
+    flag, poisoned export, port conflict) was respawned in a tight
+    loop forever.  This object bounds that: each death schedules the
+    next respawn on the policy's deterministic-jitter backoff schedule
+    (``delay_s(deaths-1)``), and once deaths exceed ``max_tries``
+    without an intervening recovery the slot is EXHAUSTED — the
+    supervisor emits ``respawn_budget_exhausted`` exactly once and
+    leaves the slot to the autoscaler.  A replica that stays alive
+    ``reset_after_s`` past its last death earns a fresh budget (rare
+    crashes over a long run must not accumulate into an exhaustion).
+
+    Pure state machine on an injectable clock — no sleeping, no
+    threads; the supervision loop drives it.
+    """
+
+    def __init__(self, policy: BackoffPolicy, reset_after_s: float = 60.0):
+        self.policy = policy
+        self.reset_after_s = reset_after_s
+        self.deaths = 0
+        self.exhausted = False
+        self.next_respawn_t = 0.0
+        self._last_death_t: float | None = None
+
+    def note_alive(self, now: float) -> None:
+        """The replica is up: reset the budget once it has survived
+        ``reset_after_s`` past the last death."""
+        if (
+            self.deaths
+            and not self.exhausted
+            and self._last_death_t is not None
+            and now - self._last_death_t >= self.reset_after_s
+        ):
+            self.deaths = 0
+            self._last_death_t = None
+
+    def note_death(self, now: float) -> bool:
+        """Record one death.  Returns True when a respawn is still in
+        budget (``next_respawn_t`` holds when); False = exhausted."""
+        if (
+            self.deaths
+            and self._last_death_t is not None
+            and now - self._last_death_t >= self.reset_after_s
+        ):
+            self.deaths = 0  # long-lived replica: fresh budget
+        self._last_death_t = now
+        self.deaths += 1
+        if self.deaths > self.policy.max_tries:
+            self.exhausted = True
+            return False
+        self.next_respawn_t = now + self.policy.delay_s(self.deaths - 1)
+        return True
+
+    def ready(self, now: float) -> bool:
+        return not self.exhausted and now >= self.next_respawn_t
+
+
+def release_subprocess(
+    proc: subprocess.Popen,
+    sigterm_timeout_s: float = 10.0,
+) -> int | None:
+    """Drain-aware subprocess release (ISSUE 19): SIGTERM (the serve
+    CLI maps it to its bounded in-flight drain), bounded wait, SIGKILL
+    only if the drain never finishes.  Returns the exit code (None if
+    even the kill-wait expired — the caller should not block forever)."""
+    if proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=sigterm_timeout_s)
+        except Exception:
+            proc.kill()
+            try:
+                proc.wait(timeout=5)
+            except Exception:
+                return None
+    return proc.returncode
+
+
 def free_port(host: str = "127.0.0.1") -> int:
     """An OS-assigned free TCP port (bind-0 probe).  Small race window
     between close and the child's bind — acceptable for smoke harnesses,
@@ -490,6 +571,8 @@ __all__ = [
     "HttpReplica",
     "LocalReplica",
     "ReplicaUnavailable",
+    "RespawnBudget",
     "free_port",
+    "release_subprocess",
     "spawn_http_replica",
 ]

@@ -39,6 +39,45 @@ def mixed_arrival_schedule(
     return times
 
 
+#: One spike window per period: (center_frac, width_frac, multiplier).
+DIURNAL_SPIKES = ((0.5, 0.15, 3.0),)
+
+
+def diurnal_spike_schedule(
+    n: int,
+    base_rate: float,
+    seed: int = 0,
+    period_s: float = 60.0,
+    amplitude: float = 0.5,
+    spikes: tuple[tuple[float, float, float], ...] = DIURNAL_SPIKES,
+) -> list[float]:
+    """Seeded diurnal + spike open-loop arrival times (ISSUE 19) — the
+    load shape an autoscaler must follow: a sinusoidal base rate (the
+    compressed "day", one cycle per ``period_s``) with multiplicative
+    burst windows riding on it.  ``spikes`` are per-period windows
+    ``(center_frac, width_frac, multiplier)`` in period-fraction units;
+    ``amplitude < 1`` keeps the off-peak rate positive so the schedule
+    always terminates.  Exponential inter-arrivals at the instantaneous
+    rate, same generator family as ``mixed_arrival_schedule`` — one
+    seed pins the entire offered-load trace, so the chaos leg and the
+    SERVEBENCH autoscale leg replay the identical day."""
+    if not 0.0 <= amplitude < 1.0:
+        raise ValueError(f"amplitude must be in [0, 1), got {amplitude}")
+    rng = np.random.default_rng(seed)
+    t, times = 0.0, []
+    for _ in range(n):
+        frac = (t % period_s) / period_s
+        rate = base_rate * (1.0 + amplitude * np.sin(2.0 * np.pi * frac))
+        for center, width, mult in spikes:
+            # Wrap-aware distance on the unit circle of the period.
+            d = abs(frac - center)
+            if min(d, 1.0 - d) <= width / 2.0:
+                rate *= mult
+        t += float(rng.exponential(1.0 / max(rate, 1e-9)))
+        times.append(t)
+    return times
+
+
 def multi_stream_schedule(
     n_streams: int,
     frames_per_stream: int,
@@ -73,4 +112,10 @@ def multi_stream_schedule(
     return streams
 
 
-__all__ = ["MIXED_PHASES", "mixed_arrival_schedule", "multi_stream_schedule"]
+__all__ = [
+    "DIURNAL_SPIKES",
+    "MIXED_PHASES",
+    "diurnal_spike_schedule",
+    "mixed_arrival_schedule",
+    "multi_stream_schedule",
+]

@@ -2077,6 +2077,265 @@ def check_stream_against_committed(fresh: dict | None) -> int:
     return rc
 
 
+def run_autoscale_leg(seed: int = 0) -> dict:
+    """SERVEBENCH autoscale leg (ISSUE 19): a seeded diurnal day with
+    one rush-hour spike replays through the REAL control plane —
+    FleetRouter + Autoscaler + LocalLauncher over in-process stub
+    replicas.  The committed record pins the elasticity contract: the
+    fleet grows under the spike (>=1 scale-up, peak >= 2 replicas), p99
+    holds through it, every request resolves (zero drops — scale-down
+    drains are invisible to clients), and the fleet returns to
+    ``min_replicas`` once the day quiets.  Pure stub —
+    device-independent, runs (and is checked) on every box."""
+    import threading
+
+    import numpy as np
+
+    from batchai_retinanet_horovod_coco_tpu.serve import (
+        AutoscalePolicy,
+        Autoscaler,
+        DetectionServer,
+        FleetConfig,
+        FleetRouter,
+        LocalLauncher,
+        LocalReplica,
+        RequestRejected,
+        ServeConfig,
+        ServeError,
+    )
+    from batchai_retinanet_horovod_coco_tpu.serve.stub import (
+        StubDetectEngine,
+    )
+    from batchai_retinanet_horovod_coco_tpu.utils.arrivals import (
+        diurnal_spike_schedule,
+    )
+
+    n = int(os.environ.get("SERVEBENCH_AUTOSCALE_REQUESTS", "240"))
+    base_rate = float(os.environ.get("SERVEBENCH_AUTOSCALE_RATE", "12"))
+    clients = 16
+
+    def factory(rid):
+        server = DetectionServer(
+            StubDetectEngine(delay_s=0.06),
+            ServeConfig(max_delay_ms=2.0, preprocess_workers=1),
+            replica_id=rid,
+        )
+        return LocalReplica(server)
+
+    launcher = LocalLauncher(
+        factory, drain_timeout_s=15.0, prefix="bench-scale"
+    )
+    seed_replica = factory("bench-scale-seed")
+    launcher.adopt(seed_replica)
+    router = FleetRouter(
+        [seed_replica],
+        FleetConfig(poll_interval_s=0.1, default_timeout_s=30.0),
+    )
+    # The chaos.py --autoscale leg proved this band/cadence against the
+    # same 60 ms stub: off-peak sits inside the band, the 4x spike
+    # breaches high, the post-day quiet breaches low back to min.
+    policy = AutoscalePolicy(
+        min_replicas=1, max_replicas=3,
+        occupancy_low=0.15, occupancy_high=0.5,
+        for_s=0.4, up_cooldown_s=1.0, down_cooldown_s=2.0,
+        interval_s=0.1,
+    )
+    scaler = Autoscaler(router, policy, launcher).start()
+
+    times = diurnal_spike_schedule(
+        n, base_rate=base_rate, seed=seed, period_s=12.0,
+        amplitude=0.5, spikes=((0.55, 0.4, 4.0),),
+    )
+    img = np.zeros((64, 64, 3), np.uint8)
+    lock = threading.Lock()
+    next_i = [0]
+    latencies: list[float] = []
+    counts = {"ok": 0, "shed": 0, "dropped": 0}
+
+    def client():
+        try:
+            while True:
+                with lock:
+                    i = next_i[0]
+                    if i >= len(times):
+                        return
+                    next_i[0] += 1
+                wait = times[i] - (time.perf_counter() - t0)
+                if wait > 0:
+                    time.sleep(wait)  # open-loop pacing; busy = slip
+                t1 = time.perf_counter()
+                try:
+                    router.detect(img)
+                    with lock:
+                        counts["ok"] += 1
+                        latencies.append(
+                            (time.perf_counter() - t1) * 1e3
+                        )
+                except RequestRejected:
+                    with lock:
+                        counts["shed"] += 1
+                except ServeError:
+                    with lock:
+                        counts["dropped"] += 1
+        except Exception as e:  # crash channel: an unresolved request
+            print(f"# autoscale leg client crashed: {e!r}", flush=True)
+            with lock:
+                counts["dropped"] += 1
+            raise
+
+    # watchdog: bench-local load generators, bounded by the join below.
+    threads = [
+        threading.Thread(target=client, daemon=True)
+        for _ in range(clients)
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+
+    # Sampler doubles as the join loop: the replica-count trajectory vs
+    # offered load is the record's elasticity evidence.
+    trajectory: list[list[float]] = []
+    deadline = t0 + times[-1] + 120.0
+    while any(t.is_alive() for t in threads):
+        if time.perf_counter() > deadline:
+            break
+        with lock:
+            offered = next_i[0]
+        trajectory.append([
+            round(time.perf_counter() - t0, 2),
+            float(offered),
+            float(router.active_replica_count()),
+        ])
+        time.sleep(0.5)
+    for t in threads:
+        t.join(timeout=10)
+    hung = sum(t.is_alive() for t in threads)
+
+    # The day is over: wait for the scale-down half of the contract —
+    # the quiet fleet drains back to min_replicas with zero drops.
+    quiet_deadline = time.perf_counter() + 60.0
+    while time.perf_counter() < quiet_deadline:
+        st = scaler.status()
+        if (router.active_replica_count() <= policy.min_replicas
+                and st["scale_downs"] >= 1 and not st["draining"]):
+            break
+        trajectory.append([
+            round(time.perf_counter() - t0, 2),
+            float(n),
+            float(router.active_replica_count()),
+        ])
+        time.sleep(0.25)
+    final_replicas = router.active_replica_count()
+    st = scaler.status()
+    decisions = [
+        {"decision": d["decision"], "reason": d["reason"],
+         "delta": d["delta"]}
+        for d in scaler.decisions
+    ]
+    scaler.stop()
+    router.close(close_replicas=True)
+
+    peak = max([s[2] for s in trajectory] or [1.0])
+    p99 = (
+        round(float(np.percentile(np.asarray(latencies), 99)), 2)
+        if latencies else None
+    )
+    return {
+        "engine": "stub",
+        "seed": seed,
+        "requests": n,
+        "completed": counts["ok"],
+        "shed": counts["shed"],
+        "dropped": counts["dropped"] + hung,
+        "p99_ms": p99,
+        "scaled_up": st["scale_ups"],
+        "scaled_down": st["scale_downs"],
+        "capped": st["capped"],
+        "peak_replicas": int(peak),
+        "final_replicas": int(final_replicas),
+        "min_replicas": policy.min_replicas,
+        "max_replicas": policy.max_replicas,
+        "decisions": decisions,
+        # Downsampled so the committed artifact stays reviewable.
+        "trajectory": trajectory[::2],
+    }
+
+
+def check_autoscale_against_committed(fresh: dict | None) -> int:
+    """The autoscaling half of servebench-check (ISSUE 19).  Structural
+    contracts are device-independent and always enforced: zero dropped
+    requests (scale-down drains never kill in-flight work), the fleet
+    grew under the spike, and it returned to min_replicas once the day
+    quieted.  The absolute p99 band against the committed record
+    applies only same-engine, wide — stub wall-clock is noisy."""
+    try:
+        with open(_artifact_path("SERVEBENCH.json")) as f:
+            committed = json.load(f).get("autoscale")
+    except (OSError, ValueError) as e:
+        print(f"# servebench-check[autoscale]: cannot read baseline: {e}")
+        return 1
+    if fresh is None:
+        print("# servebench-check[autoscale]: leg disabled "
+              "(SERVEBENCH_AUTOSCALE=0) — the committed record goes "
+              "UNCHECKED this run")
+        return 0
+    rc = 0
+    if fresh.get("dropped"):
+        print(f"# servebench-check[autoscale]: {fresh['dropped']} "
+              "requests never resolved across scaling: REGRESSION")
+        rc = 1
+    if not fresh.get("scaled_up"):
+        print("# servebench-check[autoscale]: the fleet never scaled "
+              "up under the spike — the control loop is dead: "
+              "REGRESSION")
+        rc = 1
+    if fresh.get("peak_replicas", 0) < 2:
+        print("# servebench-check[autoscale]: peak replica count "
+              f"{fresh.get('peak_replicas')} — the spike never grew "
+              "the fleet: REGRESSION")
+        rc = 1
+    if (not fresh.get("scaled_down")
+            or fresh.get("final_replicas") != fresh.get("min_replicas")):
+        print("# servebench-check[autoscale]: fleet ended at "
+              f"{fresh.get('final_replicas')} replicas (min "
+              f"{fresh.get('min_replicas')}) — never returned to min "
+              "after the day quieted: REGRESSION")
+        rc = 1
+    if committed is None:
+        print("# servebench-check[autoscale]: committed SERVEBENCH.json "
+              "has no autoscale record yet — re-capture with "
+              "`make servebench`")
+        return rc
+    if committed.get("engine") == fresh.get("engine"):
+        band = float(
+            os.environ.get("SERVEBENCH_AUTOSCALE_P99_BAND", "3.0")
+        )
+        c99, f99 = committed.get("p99_ms"), fresh.get("p99_ms")
+        if c99 and f99 and f99 > band * float(c99):
+            print(
+                f"# servebench-check[autoscale]: p99 {f99}ms above "
+                f"{band}x the committed {c99}ms — latency not held "
+                "through the spike: REGRESSION"
+            )
+            rc = 1
+    else:
+        print(
+            "# servebench-check[autoscale]: committed leg ran engine="
+            f"{committed.get('engine')}, fresh ran "
+            f"{fresh.get('engine')} — absolute bands skipped "
+            "(structural contracts enforced above)"
+        )
+    if rc == 0:
+        print(
+            f"# servebench-check[autoscale]: {fresh['completed']} ok / "
+            f"{fresh['shed']} shed, peak {fresh['peak_replicas']} "
+            f"replicas, {fresh['scaled_up']} up / "
+            f"{fresh['scaled_down']} down, p99 {fresh.get('p99_ms')}ms, "
+            "zero dropped: ok"
+        )
+    return rc
+
+
 def _scrape_telemetry(server) -> dict:
     """Scrape the live-telemetry plane ONCE per measurement window
     (ISSUE 9 satellite): mount the real HTTP frontend over the just-
@@ -2470,12 +2729,14 @@ def check_fleet_against_committed(fresh: dict | None) -> int:
 def check_serve_against_committed(
     value: float, device_kind: str, fleet: dict | None = None,
     continuous: dict | None = None, stream: dict | None = None,
+    autoscale: dict | None = None,
 ) -> int:
     """servebench-check: fresh flagship closed-loop SERVE rate vs the
     committed SERVEBENCH.json — same floor/device policy as bench-check
     (``_check_floor``) — plus the fleet availability band (ISSUE 12),
-    the continuous-batching occupancy/p99 contract (ISSUE 14), and the
-    streaming-session contract (ISSUE 18)."""
+    the continuous-batching occupancy/p99 contract (ISSUE 14), the
+    streaming-session contract (ISSUE 18), and the autoscale
+    elasticity contract (ISSUE 19)."""
     try:
         with open(_artifact_path("SERVEBENCH.json")) as f:
             committed = json.load(f)
@@ -2495,6 +2756,7 @@ def check_serve_against_committed(
         check_fleet_against_committed(fleet),
         check_continuous_against_committed(continuous),
         check_stream_against_committed(stream),
+        check_autoscale_against_committed(autoscale),
     )
 
 
@@ -2571,6 +2833,14 @@ def run_serve_mode() -> None:
         with obs_trace.span("serve_stream_leg"):
             stream = run_stream_leg()
         out["stream"] = stream
+    # Autoscale leg (ISSUE 19): the seeded diurnal/spike day through the
+    # real control plane (FleetRouter + Autoscaler) over stub replicas —
+    # device-independent.  SERVEBENCH_AUTOSCALE=0 skips.
+    autoscale = None
+    if os.environ.get("SERVEBENCH_AUTOSCALE", "1") not in ("", "0"):
+        with obs_trace.span("serve_autoscale_leg"):
+            autoscale = run_autoscale_leg()
+        out["autoscale"] = autoscale
     att = _trace_attribution()
     if att is not None:
         out["attribution"] = att
@@ -2579,7 +2849,7 @@ def run_serve_mode() -> None:
     if os.environ.get("BENCH_CHECK", "") not in ("", "0"):
         raise SystemExit(
             check_serve_against_committed(
-                value, device_kind, fleet, cont, stream
+                value, device_kind, fleet, cont, stream, autoscale
             )
         )
 

@@ -889,6 +889,304 @@ def _serve_canary_leg() -> None:
         fleet.stop()
 
 
+def _paced_storm(
+    base_url: str, payload: bytes, times: list[float], clients: int,
+    mid_action=None, request_timeout: float = 30.0,
+) -> dict:
+    """Open-loop load: fire one request per entry of ``times`` (absolute
+    seconds from leg start — the seeded arrival schedule), bounded by a
+    worker pool so a lagging fleet backs pressure up into occupancy
+    instead of unbounded client threads.  ``mid_action()`` runs once,
+    as the halfway arrival is claimed.  Same outcome taxonomy as
+    ``_fleet_storm``: every request must RESOLVE."""
+    import threading
+    import urllib.error
+    import urllib.request
+
+    lock = threading.Lock()
+    counts = {"ok": 0, "shed": 0, "timeout": 0, "server_error": 0,
+              "router_unreachable": 0, "hung": 0, "other": 0}
+    idx = [0]
+    acted = [False]
+    t0 = time.monotonic()
+
+    def one_request():
+        req = urllib.request.Request(
+            f"{base_url}/detect", data=payload, method="POST"
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=request_timeout) as r:
+                json.loads(r.read().decode())
+                return "ok"
+        except urllib.error.HTTPError as e:
+            body = {}
+            try:
+                body = json.loads(e.read().decode())
+            except Exception:
+                pass
+            if e.code == 503:
+                return "shed" if body.get("reason") else "other"
+            if e.code == 504:
+                return "timeout"
+            return "server_error"
+        except TimeoutError:
+            return "hung"
+        except Exception as e:
+            if "timed out" in str(e).lower():
+                return "hung"
+            return "router_unreachable"
+
+    def client():
+        try:
+            while True:
+                with lock:
+                    if idx[0] >= len(times):
+                        return
+                    i = idx[0]
+                    idx[0] += 1
+                    fire = i == len(times) // 2 and not acted[0]
+                    if fire:
+                        acted[0] = True
+                delay = times[i] - (time.monotonic() - t0)
+                if delay > 0:
+                    time.sleep(delay)
+                if fire and mid_action is not None:
+                    mid_action()
+                outcome = one_request()
+                with lock:
+                    counts[outcome] += 1
+        except Exception as e:  # crash channel: a dead client = hung reqs
+            with lock:
+                counts["other"] += 1
+            print(f"chaos FAIL: storm client crashed: {e!r}", flush=True)
+
+    # watchdog: harness-local load generators; every request is bounded
+    # by its own urlopen timeout, the driver joins with a budget below.
+    threads = [
+        threading.Thread(target=client, daemon=True)
+        for _ in range(clients)
+    ]
+    for t in threads:
+        t.start()
+    budget = (times[-1] if times else 0.0) + request_timeout * 4 + 60
+    for t in threads:
+        t.join(timeout=budget)
+    counts["submitted"] = idx[0]
+    counts["resolved"] = sum(
+        counts[k] for k in ("ok", "shed", "timeout", "server_error")
+    )
+    return counts
+
+
+def _closed_replicas(base_url: str) -> list[str]:
+    return [
+        r["replica_id"]
+        for r in _fleet_status(base_url).get("replicas", [])
+        if r["state"] == "closed"
+    ]
+
+
+def _serve_autoscale_leg() -> None:
+    """The seeded diurnal/spike day against a 1..3 autoscaling stub
+    fleet, with a mid-spike SIGKILL of the seed replica: the fleet must
+    scale 1→N under the spike, lose nothing (every request resolves,
+    zero hangs), repair the preempted replica, and come back down to
+    one replica once the day goes quiet."""
+    sys.path.insert(0, _REPO)
+    try:
+        from batchai_retinanet_horovod_coco_tpu.utils.arrivals import (
+            diurnal_spike_schedule,
+        )
+    finally:
+        sys.path.pop(0)
+
+    fleet = _FleetUnderTest("serve_autoscale", [
+        "--spawn", "1", "--stub-engine", "--stub-delay-ms", "60",
+        "--poll-interval", "0.2", "--respawn-delay-s", "0.3",
+        "--fleet-timeout-s", "20",
+        "--autoscale", "--min-replicas", "1", "--max-replicas", "3",
+        "--target-occupancy", "0.15:0.5", "--autoscale-for-s", "0.4",
+        "--autoscale-up-cooldown-s", "1",
+        "--autoscale-down-cooldown-s", "2",
+        "--autoscale-interval-s", "0.2",
+    ])
+    try:
+        check(
+            len(fleet.events("autoscaler_armed")) == 1,
+            "autoscale leg: autoscaler_armed never emitted",
+        )
+        spawned = fleet.events("fleet_replica_spawned")
+        check(
+            len(spawned) == 1, f"expected 1 seed spawn, saw {len(spawned)}"
+        )
+        killed: list[str] = []
+
+        def preempt():
+            """SIGKILL a replica that is ROUTABLE at kill time — the
+            autoscaler may have already scaled the seed replica away
+            during the pre-spike lull, so the victim is chosen live."""
+            pids: dict[str, int] = {}
+            for e in (fleet.events("fleet_replica_spawned")
+                      + fleet.events("fleet_replica_respawned")):
+                pids[e["replica_id"]] = e["pid"]  # latest pid wins
+            for rid in _closed_replicas(fleet.base_url):
+                if rid not in pids:
+                    continue
+                try:
+                    os.kill(pids[rid], signal.SIGKILL)
+                except ProcessLookupError:
+                    continue
+                killed.append(rid)
+                return
+        # One compressed "day": sinusoidal base with a 4x burst window —
+        # the ~55 rps spike saturates one 60ms-stub replica (≈16 rps)
+        # and MUST force a scale-up; the window is wide enough (~6 s of
+        # arrivals) that the breach re-sustains after the mid-spike
+        # SIGKILL resets it.
+        times = diurnal_spike_schedule(
+            450, base_rate=12.0, seed=5, period_s=20.0, amplitude=0.5,
+            spikes=((0.55, 0.5, 4.0),),
+        )
+        counts = _paced_storm(
+            fleet.base_url, _fleet_payload(), times, clients=10,
+            mid_action=preempt,
+        )
+        check(bool(killed), "autoscale leg: found no routable replica "
+                            "to SIGKILL mid-spike")
+        check(counts["hung"] == 0, f"autoscale leg: hung clients: {counts}")
+        check(
+            counts["router_unreachable"] == 0 and counts["other"] == 0,
+            f"autoscale leg: dropped/garbled requests: {counts}",
+        )
+        check(
+            counts["resolved"] == counts["submitted"],
+            f"autoscale leg: silent drops: {counts}",
+        )
+        check(counts["ok"] > 0, f"autoscale leg: nothing completed: {counts}")
+        # The spike forced at least one scale-up...
+        ups = [
+            e for e in fleet.events("autoscale_decision")
+            if e.get("decision") == "scale_up"
+        ]
+        check(bool(ups), "autoscale leg: no scale_up decision under spike")
+        check(
+            _metric_value(fleet.base_url, "fleet_scale_up_total") >= 1,
+            "autoscale leg: fleet_scale_up_total never incremented",
+        )
+        check(
+            len(fleet.events("fleet_replica_joined")) >= 1,
+            "autoscale leg: no replica joined the router",
+        )
+        # ... the SIGKILLed seed replica was repaired (respawn budget) ...
+        _wait_until(
+            lambda: len(fleet.events("fleet_replica_respawned")) >= 1,
+            60, "autoscale leg: preempted replica never respawned",
+        )
+        # ... and the quiet tail of the day scales back down to min.
+        _wait_until(
+            lambda: len(_closed_replicas(fleet.base_url)) == 1
+            and _metric_value(
+                fleet.base_url, "fleet_scale_down_total"
+            ) >= 1,
+            90, "autoscale leg: fleet never scaled back down to 1",
+        )
+        # Post-scale-down traffic still serves (zero-drop drain).
+        post = _fleet_storm(
+            fleet.base_url, _fleet_payload(), total=8, clients=2
+        )
+        check(
+            post["ok"] == post["submitted"],
+            f"autoscale leg: post-scale-down traffic unhealthy: {post}",
+        )
+        # The decision surface is on the scrape.
+        _code, metrics_body = _http_get(f"{fleet.base_url}/metrics")
+        for fam in ("fleet_replicas_desired", "fleet_replicas_active",
+                    "fleet_occupancy", "fleet_scale_up_total",
+                    "fleet_scale_down_total"):
+            check(
+                fam.encode() in metrics_body,
+                f"autoscale leg: {fam} missing from fleet /metrics",
+            )
+    finally:
+        fleet.stop()
+
+
+def _serve_scale_to_zero_leg() -> None:
+    """A cold tier (min_replicas=0): strict idleness takes the fleet to
+    ZERO replicas; the first request sheds at the edge and that demand
+    signal respawns capacity — the client's retry loop lands."""
+    fleet = _FleetUnderTest("serve_scale_zero", [
+        "--spawn", "1", "--stub-engine", "--stub-delay-ms", "5",
+        "--poll-interval", "0.2", "--fleet-timeout-s", "20",
+        "--autoscale", "--min-replicas", "0", "--max-replicas", "2",
+        "--target-occupancy", "0.15:0.6", "--autoscale-for-s", "0.4",
+        "--autoscale-up-cooldown-s", "0.5",
+        "--autoscale-down-cooldown-s", "1",
+        "--autoscale-interval-s", "0.2",
+    ])
+    try:
+        warm = _fleet_storm(
+            fleet.base_url, _fleet_payload(), total=4, clients=2
+        )
+        check(
+            warm["ok"] == warm["submitted"],
+            f"scale-to-zero leg: warm traffic unhealthy: {warm}",
+        )
+        # Idle → the last replica drains away: an EMPTY fleet.
+        _wait_until(
+            lambda: not _fleet_status(fleet.base_url).get("replicas"),
+            90, "scale-to-zero leg: idle fleet never reached 0 replicas",
+        )
+        downs = [
+            e for e in fleet.events("autoscale_decision")
+            if e.get("decision") == "scale_down"
+        ]
+        check(
+            bool(downs) and downs[-1].get("reason") == "idle",
+            f"scale-to-zero leg: expected an idle scale_down: {downs}",
+        )
+        # First request hits the empty fleet: a REASONED shed, then the
+        # demand signal scales from zero and a bounded retry loop lands.
+        payload = _fleet_payload()
+        deadline = time.monotonic() + 90
+        outcomes = []
+        recovered = False
+        while time.monotonic() < deadline:
+            code, body = 0, b""
+            try:
+                import urllib.request
+                req = urllib.request.Request(
+                    f"{fleet.base_url}/detect", data=payload,
+                    method="POST",
+                )
+                with urllib.request.urlopen(req, timeout=20) as r:
+                    code, body = r.status, r.read()
+            except Exception as e:
+                import urllib.error
+                if isinstance(e, urllib.error.HTTPError):
+                    code, body = e.code, e.read()
+            outcomes.append(code)
+            if code == 200:
+                recovered = True
+                break
+            time.sleep(0.5)
+        check(
+            recovered,
+            f"scale-to-zero leg: fleet never recovered from zero "
+            f"(outcomes {outcomes[-10:]})",
+        )
+        wakes = [
+            e for e in fleet.events("autoscale_decision")
+            if e.get("reason") == "demand_scale_from_zero"
+        ]
+        check(
+            len(wakes) >= 1,
+            "scale-to-zero leg: no demand_scale_from_zero decision",
+        )
+    finally:
+        fleet.stop()
+
+
 def run_serve_legs() -> None:
     """The fleet serve schedule (``make fleet-smoke`` / ``--serve``).
     Since ISSUE 14 the replicas run CONTINUOUS in-flight batching (the
@@ -896,6 +1194,15 @@ def run_serve_legs() -> None:
     so the chaos contracts are proven against the slot-pool path."""
     _serve_kill_leg()
     _serve_canary_leg()
+
+
+def run_autoscale_legs() -> None:
+    """The autoscaling schedule (``make scale-smoke`` / ``--autoscale``,
+    ISSUE 19): the diurnal/spike 1→N→1 leg with a mid-spike SIGKILL,
+    then the scale-to-zero cold-tier leg."""
+    _serve_autoscale_leg()
+    if not _failures:
+        _serve_scale_to_zero_leg()
 
 
 # ---------------------------------------------------------------------------
@@ -1085,6 +1392,15 @@ def main(argv=None) -> int:
                         "200s throughout, breaker reopens after respawn) "
                         "+ the slow-canary rollback leg (exactly one "
                         "canary_rollback, fleet back to baseline)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="autoscale legs only (make scale-smoke): the "
+                        "seeded diurnal/spike day against a 1..3 "
+                        "autoscaling stub fleet with a mid-spike "
+                        "SIGKILL (1→N on the spike, preemption "
+                        "repaired, back to 1 when quiet, zero "
+                        "hangs/drops), then the scale-to-zero cold "
+                        "tier (idle fleet reaches 0 replicas and "
+                        "recovers on the first request)")
     p.add_argument("--comm", action="store_true",
                    help="comm leg only (make chaos-comm): SIGKILL a "
                         "--comm-compress int8 run mid-save; the resume "
@@ -1113,6 +1429,14 @@ def main(argv=None) -> int:
 
     if args.serve:
         run_serve_legs()
+        print(json.dumps({
+            "chaos": "ok" if not _failures else "FAIL",
+            "failures": _failures,
+        }), flush=True)
+        return 1 if _failures else 0
+
+    if args.autoscale:
+        run_autoscale_legs()
         print(json.dumps({
             "chaos": "ok" if not _failures else "FAIL",
             "failures": _failures,
@@ -1163,6 +1487,8 @@ def main(argv=None) -> int:
             _comm_leg(hier=True)  # per-hop EF durability (ISSUE 16)
         if not _failures:
             run_serve_legs()  # the serve-side half of the full schedule
+        if not _failures:
+            run_autoscale_legs()  # elasticity contracts (ISSUE 19)
         print(f"# chaos: {kills} scheduled kills executed", flush=True)
 
     if not _failures:

@@ -1,0 +1,765 @@
+"""serve/autoscale.py — the autoscaling control plane (ISSUE 19).
+
+Everything runs on the injectable clock: ``check_once(now=...)`` drives
+the sustain/cooldown state machine (like ``poll_once`` drives the
+breaker), so no test sleeps to make policy time pass.
+
+Families:
+
+- **Policy contract**: bounds validation, unknown-key rejection in
+  policy files, file round-trip.
+- **Anti-flap** (the acceptance pins): a sustained occupancy breach
+  fires EXACTLY one decision per cooldown window; oscillating load
+  inside the hysteresis band fires ZERO decisions; a breach shorter
+  than ``for_s`` fires nothing.
+- **Scale-up**: occupancy and p99 breach paths, the capped decision at
+  ``max_replicas`` (the ``fleet:underprovisioned`` evidence), launch
+  failures counted without crashing the loop, the weight-zero admission
+  gate on joined replicas.
+- **Scale-down**: lowest-weight owned victim, drain → reap → removal
+  lifecycle, canary/foreign replicas never victimized, the draining
+  gauge + occupancy exclusion (the /metrics truthfulness satellite).
+- **Scale-to-zero**: strict idleness takes the last replica away;
+  demand (a ``no_replica_available`` shed) scales from zero
+  IMMEDIATELY — no sustain, no cooldown.
+- **Preemption repair**: a pruned (respawn-budget-exhausted) slot plus
+  ``below_min`` repairs capacity on the same tick.
+- **RespawnBudget**: the bounded-respawn state machine behind the fleet
+  CLI supervision bugfix.
+- **Zero-drop scale-down** (real servers): in-flight requests on the
+  victim all complete, the router redistributes, no errors; a pinned
+  stream on the victim re-pins with exactly one ``stream_repinned`` and
+  zero dropped frames.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from batchai_retinanet_horovod_coco_tpu.serve import (
+    AutoscalePolicy,
+    Autoscaler,
+    DetectionServer,
+    FleetConfig,
+    FleetRouter,
+    LocalLauncher,
+    LocalReplica,
+    RequestRejected,
+    ServeConfig,
+)
+from batchai_retinanet_horovod_coco_tpu.serve.replica import RespawnBudget
+from batchai_retinanet_horovod_coco_tpu.serve.stub import StubDetectEngine
+from batchai_retinanet_horovod_coco_tpu.utils.backoff import BackoffPolicy
+
+DETS = [{"category_id": 0, "bbox": [1.0, 2.0, 9.0, 18.0], "score": 0.5}]
+
+#: No-jitter breaker backoff — probe times are exact in these tests.
+EXACT_BACKOFF = BackoffPolicy(
+    max_tries=1_000_000, base_s=1.0, multiplier=2.0, ceiling_s=8.0,
+    jitter=0.0,
+)
+
+
+class ScalableReplica:
+    """A replica handle advertising the SLOT fields the occupancy
+    aggregate reads (``slot_capacity``/``free_slots``), with scriptable
+    occupancy — the autoscale counterpart of test_fleet's FakeReplica."""
+
+    def __init__(
+        self,
+        replica_id: str,
+        version: str = "v1",
+        capacity: int = 8,
+        p99_ms: float | None = 100.0,
+    ):
+        self.replica_id = replica_id
+        self.version = version
+        self.capacity = capacity
+        self.p99_ms = p99_ms
+        self.inflight = 0
+        self.accepting = True
+        self.healthy = True
+        self.drained = False
+        self.closed = False
+
+    def set_occupancy(self, frac: float) -> None:
+        """Advertise ``frac`` of slots claimed on the next health poll."""
+        self.inflight = round(frac * self.capacity)
+
+    def load(self) -> dict:
+        free = self.capacity - self.inflight
+        return {
+            "replica_id": self.replica_id,
+            "version": self.version,
+            "inflight": self.inflight,
+            "admission_qsize": 0,
+            "admission_capacity": self.capacity,
+            "slot_capacity": self.capacity,
+            "free_slots": free,
+            "p99_ms": self.p99_ms,
+            "shed_total": 0,
+            "accepting": self.accepting,
+        }
+
+    def healthz(self):
+        if not self.healthy:
+            return 0, {"status": "unreachable"}
+        return 200, {"status": "ok", "load": self.load()}
+
+    def detect(self, payload, timeout_s=None):
+        return DETS
+
+    def drain(self, timeout_s=5.0):
+        self.drained = True
+        self.accepting = False
+
+    def close(self):
+        self.closed = True
+        self.accepting = False
+
+
+class FakeLauncher:
+    """Scriptable duck-typed launcher: launches ScalableReplicas, owns
+    what it launched or adopted, reaps on demand (``reap_ready``)."""
+
+    def __init__(self):
+        self.launched: list[ScalableReplica] = []
+        self.terminated: list[str] = []
+        self.reap_ready: set[str] = set()
+        self.abandoned: list[str] = []
+        self.fail_launches = 0
+        self._owned: set[str] = set()
+        self._seq = 0
+
+    def launch(self):
+        if self.fail_launches:
+            self.fail_launches -= 1
+            raise RuntimeError("spawn refused (scripted)")
+        rid = f"scale-{self._seq}"
+        self._seq += 1
+        replica = ScalableReplica(rid)
+        self.launched.append(replica)
+        self._owned.add(rid)
+        return replica
+
+    def adopt(self, replica) -> None:
+        self._owned.add(replica.replica_id)
+
+    def owns(self, rid: str) -> bool:
+        return rid in self._owned
+
+    def terminate(self, rid: str) -> None:
+        self.terminated.append(rid)
+
+    def reap(self, rid: str) -> bool:
+        if rid in self.reap_ready:
+            self._owned.discard(rid)
+            return True
+        return False
+
+    def prune(self) -> list[str]:
+        out, self.abandoned = self.abandoned, []
+        return out
+
+
+class _SinkSpy:
+    def __init__(self):
+        self.events: list[tuple[str, dict]] = []
+
+    def event(self, kind: str, **fields) -> None:
+        self.events.append((kind, fields))
+
+    def of(self, kind: str) -> list[dict]:
+        return [f for k, f in self.events if k == kind]
+
+
+def make_scaler(replicas, policy, launcher=None, sink=None, adopt=True):
+    launcher = launcher or FakeLauncher()
+    router = FleetRouter(
+        replicas,
+        FleetConfig(probe_backoff=EXACT_BACKOFF, poll_interval_s=0.05),
+        sink=sink,
+        auto_poll=False,
+    )
+    if adopt:
+        for r in replicas:
+            launcher.adopt(r)
+    scaler = Autoscaler(router, policy, launcher, sink=sink)
+    return router, scaler, launcher
+
+
+#: The band policy most tests drive: decisions need a 5s sustained
+#: breach and respect a 10s per-direction cooldown.
+BAND = dict(
+    min_replicas=1, max_replicas=3, occupancy_low=0.25,
+    occupancy_high=0.75, for_s=5.0, up_cooldown_s=10.0,
+    down_cooldown_s=10.0,
+)
+
+
+def tick(router, scaler, now: float) -> list[dict]:
+    router.poll_once(now=now)
+    return scaler.check_once(now=now)
+
+
+# ---- policy contract -----------------------------------------------------
+
+
+class TestPolicy:
+    def test_bounds_validation(self):
+        with pytest.raises(ValueError, match="min_replicas"):
+            AutoscalePolicy(min_replicas=-1)
+        with pytest.raises(ValueError, match="max_replicas"):
+            AutoscalePolicy(min_replicas=3, max_replicas=2)
+        with pytest.raises(ValueError, match="max_replicas"):
+            AutoscalePolicy(min_replicas=0, max_replicas=0)
+        with pytest.raises(ValueError, match="occupancy"):
+            AutoscalePolicy(occupancy_low=0.8, occupancy_high=0.5)
+        with pytest.raises(ValueError, match="occupancy"):
+            AutoscalePolicy(occupancy_low=0.2, occupancy_high=1.5)
+        with pytest.raises(ValueError, match="steps"):
+            AutoscalePolicy(scale_up_step=0)
+        with pytest.raises(ValueError, match="for_s"):
+            AutoscalePolicy(for_s=-1.0)
+        # min_replicas=0 (scale-to-zero) is a legal contract.
+        assert AutoscalePolicy(min_replicas=0).min_replicas == 0
+
+    def test_policy_file_round_trip_and_unknown_key(self, tmp_path):
+        doc = {
+            "min_replicas": 0, "max_replicas": 5,
+            "occupancy_low": 0.2, "occupancy_high": 0.8,
+            "p99_slo_ms": 250.0, "for_s": 2.0,
+        }
+        path = tmp_path / "policy.json"
+        path.write_text(json.dumps(doc))
+        pol = AutoscalePolicy.from_file(str(path))
+        assert pol.max_replicas == 5 and pol.p99_slo_ms == 250.0
+        assert pol.up_cooldown_s == 10.0  # unset knobs keep defaults
+        # A typo'd knob is an ERROR, not a silent default.
+        with pytest.raises(ValueError, match="max_replicaz"):
+            AutoscalePolicy.from_json({"max_replicaz": 5})
+
+
+# ---- anti-flap (the acceptance pins) -------------------------------------
+
+
+class TestAntiFlap:
+    def test_sustained_breach_one_decision_per_cooldown_window(self):
+        r0 = ScalableReplica("r0")
+        r0.set_occupancy(1.0)  # saturated for the whole test
+        router, scaler, launcher = make_scaler(
+            [r0], AutoscalePolicy(**BAND)
+        )
+        decisions = []
+        # Breach from t=0; for_s=5, up_cooldown=10.  Dense ticking must
+        # fire exactly at t=5 (sustain met) and t=15 (cooldown met).
+        # Demand outgrows capacity: joined replicas saturate too, so the
+        # breach SUSTAINS across both windows.
+        for now in np.arange(0.0, 20.0, 0.5):
+            for r in launcher.launched:
+                r.set_occupancy(1.0)
+            decisions += tick(router, scaler, float(now))
+        assert [d["decision"] for d in decisions] == ["scale_up"] * 2
+        assert [d["reason"] for d in decisions] == ["occupancy_high"] * 2
+        assert len(launcher.launched) == 2
+        snap = router.federated_snapshot()
+        assert snap["fleet_scale_up_total"] == 2.0
+
+    def test_oscillation_inside_band_zero_decisions(self):
+        r0 = ScalableReplica("r0")
+        router, scaler, _ = make_scaler([r0], AutoscalePolicy(**BAND))
+        decisions = []
+        for i, now in enumerate(np.arange(0.0, 30.0, 0.5)):
+            # 0.375 ↔ 0.625 with band [0.25, 0.75]: real load swing,
+            # never a breach.
+            r0.set_occupancy(0.375 if i % 2 else 0.625)
+            decisions += tick(router, scaler, float(now))
+        assert decisions == []
+        st = scaler.status()
+        assert not st["breaching_up"] and not st["breaching_down"]
+        assert st["scale_ups"] == 0 and st["scale_downs"] == 0
+
+    def test_breach_shorter_than_for_s_fires_nothing(self):
+        r0 = ScalableReplica("r0")
+        router, scaler, _ = make_scaler([r0], AutoscalePolicy(**BAND))
+        decisions = []
+        # High for 3s (< for_s=5), back in band, high again for 3s:
+        # the sustain clock must RESET on re-entry, so nothing fires.
+        for now, occ in [(0, 1.0), (1, 1.0), (3, 1.0), (4, 0.5),
+                         (10, 1.0), (11, 1.0), (13, 1.0), (14, 0.5)]:
+            r0.set_occupancy(occ)
+            decisions += tick(router, scaler, float(now))
+        assert decisions == []
+
+
+# ---- scale-up ------------------------------------------------------------
+
+
+class TestScaleUp:
+    def test_p99_breach_scales_up_inside_band(self):
+        r0 = ScalableReplica("r0", p99_ms=500.0)
+        r0.set_occupancy(0.5)  # inside the band — latency is the signal
+        router, scaler, launcher = make_scaler(
+            [r0], AutoscalePolicy(p99_slo_ms=200.0, **BAND)
+        )
+        fired = []
+        for now in (0.0, 2.0, 5.0):
+            fired += tick(router, scaler, now)
+        assert [d["reason"] for d in fired] == ["p99_breach"]
+        assert fired[0]["p99_ms"] == 500.0
+        assert len(launcher.launched) == 1
+
+    def test_capped_decision_at_max_replicas(self):
+        reps = [ScalableReplica(f"r{k}") for k in range(3)]
+        for r in reps:
+            r.set_occupancy(1.0)
+        router, scaler, launcher = make_scaler(
+            reps, AutoscalePolicy(**BAND)  # max_replicas=3, already there
+        )
+        decisions = []
+        for now in np.arange(0.0, 20.0, 1.0):
+            decisions += tick(router, scaler, float(now))
+        # Still once per cooldown window — but capped, delta 0, and the
+        # underprovisioned counter carries the evidence.
+        assert [d["decision"] for d in decisions] == ["scale_up_capped"] * 2
+        assert all(d["delta"] == 0 for d in decisions)
+        assert launcher.launched == []
+        snap = router.federated_snapshot()
+        assert snap["fleet_scale_capped_total"] == 2.0
+        assert snap["fleet_scale_up_total"] == 0.0
+
+    def test_joined_replica_gates_at_weight_zero_until_polled(self):
+        r0 = ScalableReplica("r0")
+        r0.set_occupancy(1.0)
+        router, scaler, launcher = make_scaler(
+            [r0], AutoscalePolicy(**BAND)
+        )
+        for now in (0.0, 5.0):
+            tick(router, scaler, now)
+        assert len(launcher.launched) == 1
+        joined = launcher.launched[0].replica_id
+        by_id = {
+            r["replica_id"]: r for r in router.status()["replicas"]
+        }
+        # Admission gate: joined but NEVER takes weight before its own
+        # first successful health poll (the half-open probe contract).
+        assert by_id[joined]["state"] == "closed"
+        assert by_id[joined]["weight"] == 0.0
+        router.poll_once(now=6.0)
+        by_id = {
+            r["replica_id"]: r for r in router.status()["replicas"]
+        }
+        assert by_id[joined]["weight"] > 0.0
+
+    def test_launch_failure_is_counted_not_fatal(self):
+        r0 = ScalableReplica("r0")
+        r0.set_occupancy(1.0)
+        sink = _SinkSpy()
+        launcher = FakeLauncher()
+        launcher.fail_launches = 1
+        router, scaler, launcher = make_scaler(
+            [r0], AutoscalePolicy(**BAND), launcher=launcher, sink=sink,
+        )
+        fired = []
+        for now in (0.0, 5.0):
+            fired += tick(router, scaler, now)
+        assert [d["decision"] for d in fired] == ["scale_up"]
+        assert fired[0]["delta"] == 0  # nothing actually joined
+        assert fired[0]["launch_errors"] == 1
+        assert len(sink.of("autoscale_launch_failed")) == 1
+        # The loop survives to retry after the cooldown.
+        fired += tick(router, scaler, 15.0)
+        assert fired[-1]["delta"] == 1
+
+
+# ---- scale-down ----------------------------------------------------------
+
+
+class TestScaleDown:
+    def test_lowest_weight_owned_victim_drains_then_removes(self):
+        sink = _SinkSpy()
+        r0, r1 = ScalableReplica("r0"), ScalableReplica("r1")
+        r1.set_occupancy(0.125)  # busier ⇒ heavier r0 survives? no:
+        # r0 idle (weight high), r1 slightly loaded (weight LOWER) —
+        # the victim must be the lowest-weight replica, r1.
+        router, scaler, launcher = make_scaler(
+            [r0, r1], AutoscalePolicy(**BAND), sink=sink
+        )
+        fired = []
+        for now in (0.0, 5.0):
+            fired += tick(router, scaler, now)
+        assert [d["decision"] for d in fired] == ["scale_down"]
+        assert fired[0]["victims"] == ["r1"]
+        assert launcher.terminated == ["r1"]
+        by_id = {
+            r["replica_id"]: r for r in router.status()["replicas"]
+        }
+        assert by_id["r1"]["state"] == "drained"
+        assert by_id["r1"]["weight"] == 0.0
+        # Draining is visible on /metrics and EXCLUDED from occupancy.
+        snap = router.federated_snapshot()
+        assert snap['fleet_replica_draining{replica="r1"}'] == 1.0
+        assert snap['fleet_replica_draining{replica="r0"}'] == 0.0
+        assert snap["fleet_autoscale_draining"] == 1.0
+        assert snap["fleet_occupancy"] == 0.0  # r1's 0.125 is gone
+        # Not reapable yet: the slot stays pending, no removal.
+        tick(router, scaler, 6.0)
+        assert "r1" in {
+            r["replica_id"] for r in router.status()["replicas"]
+        }
+        # Drain finishes; the next tick reclaims the slot.
+        launcher.reap_ready.add("r1")
+        tick(router, scaler, 7.0)
+        assert "r1" not in {
+            r["replica_id"] for r in router.status()["replicas"]
+        }
+        assert [e["replica_id"] for e in sink.of("fleet_replica_draining")] \
+            == ["r1"]
+        assert [e["replica_id"] for e in sink.of("fleet_replica_removed")] \
+            == ["r1"]
+
+    def test_unowned_and_canary_replicas_are_never_victims(self):
+        r0, r1 = ScalableReplica("r0"), ScalableReplica("r1")
+        launcher = FakeLauncher()
+        router, scaler, launcher = make_scaler(
+            [r0, r1], AutoscalePolicy(**BAND), launcher=launcher,
+            adopt=False,  # the launcher owns NEITHER seed replica
+        )
+        fired = []
+        for now in np.arange(0.0, 12.0, 1.0):
+            fired += tick(router, scaler, float(now))
+        # Below the band the whole time, but nothing the launcher owns:
+        # no decision at all (an event with no actuation would lie).
+        assert fired == []
+        assert launcher.terminated == []
+
+    def test_occupancy_aggregate_excludes_draining_replica(self):
+        r0, r1 = ScalableReplica("r0"), ScalableReplica("r1")
+        r0.set_occupancy(1.0)
+        r1.set_occupancy(0.5)
+        router, scaler, _ = make_scaler([r0, r1], AutoscalePolicy(**BAND))
+        router.poll_once(now=0.0)
+        assert router.federated_snapshot()["fleet_occupancy"] == 0.75
+        assert router.begin_drain("r0")
+        snap = router.federated_snapshot()
+        assert snap["fleet_occupancy"] == 0.5  # r0 no longer counted
+        assert snap['fleet_replica_draining{replica="r0"}'] == 1.0
+
+
+# ---- scale-to-zero + demand recovery -------------------------------------
+
+
+class TestScaleToZero:
+    def test_idle_fleet_reaches_zero_and_demand_recovers(self):
+        sink = _SinkSpy()
+        r0 = ScalableReplica("r0")
+        pol = AutoscalePolicy(
+            min_replicas=0, max_replicas=2, occupancy_low=0.25,
+            occupancy_high=0.75, for_s=2.0, up_cooldown_s=5.0,
+            down_cooldown_s=5.0,
+        )
+        router, scaler, launcher = make_scaler([r0], pol, sink=sink)
+        fired = []
+        for now in (0.0, 1.0, 2.0):
+            fired += tick(router, scaler, float(now))
+        assert [d["decision"] for d in fired] == ["scale_down"]
+        assert fired[0]["reason"] == "idle"
+        launcher.reap_ready.add("r0")
+        tick(router, scaler, 3.0)
+        assert router.status()["replicas"] == []
+        assert router.active_replica_count() == 0
+        assert router.federated_snapshot()["fleet_replicas_desired"] == 0.0
+        # A request hits the empty fleet: shed at the edge ...
+        with pytest.raises(RequestRejected, match="no_replica_available"):
+            router.detect(b"payload")
+        # ... and the VERY NEXT tick scales from zero, no sustain, no
+        # cooldown (3.0 - last_down is inside down_cooldown_s).
+        fired = scaler.check_once(now=4.0)
+        assert [d["decision"] for d in fired] == ["scale_up"]
+        assert fired[0]["reason"] == "demand_scale_from_zero"
+        assert len(launcher.launched) == 1
+        assert router.active_replica_count() == 1
+        # The recovered replica serves after its first poll.
+        router.poll_once(now=5.0)
+        assert router.detect(b"payload") == DETS
+
+    def test_trickle_traffic_keeps_last_replica_alive(self):
+        r0 = ScalableReplica("r0")
+        pol = AutoscalePolicy(
+            min_replicas=0, max_replicas=2, for_s=1.0,
+            up_cooldown_s=1.0, down_cooldown_s=1.0,
+        )
+        router, scaler, launcher = make_scaler([r0], pol)
+        fired = []
+        for now in np.arange(0.0, 8.0, 1.0):
+            router.poll_once(now=float(now))
+            router.detect(b"payload")  # sub-band trickle, NOT idle
+            fired += scaler.check_once(now=float(now))
+        # Occupancy reads 0 (below the band) but completions are
+        # flowing: strict idleness gates the LAST replica.
+        assert fired == []
+        assert router.active_replica_count() == 1
+        assert launcher.terminated == []
+
+
+# ---- preemption repair ---------------------------------------------------
+
+
+class TestPreemptionRepair:
+    def test_pruned_slot_plus_below_min_repairs_same_tick(self):
+        r0, r1 = ScalableReplica("r0"), ScalableReplica("r1")
+        r0.set_occupancy(0.5)
+        r1.set_occupancy(0.5)
+        pol = AutoscalePolicy(min_replicas=2, max_replicas=3, **{
+            k: v for k, v in BAND.items() if k.startswith(("occupancy",))
+        }, for_s=5.0, up_cooldown_s=10.0, down_cooldown_s=10.0)
+        router, scaler, launcher = make_scaler([r0, r1], pol)
+        tick(router, scaler, 0.0)
+        # The supervisor exhausted r1's respawn budget: the slot is
+        # abandoned to the autoscaler ...
+        launcher.abandoned.append("r1")
+        launcher._owned.discard("r1")
+        fired = scaler.check_once(now=1.0)
+        # ... which forgets the corpse AND repairs capacity below the
+        # floor on the SAME tick — no sustain, no cooldown.
+        assert "r1" not in {
+            r["replica_id"] for r in router.status()["replicas"]
+        }
+        assert [d["decision"] for d in fired] == ["scale_up"]
+        assert fired[0]["reason"] == "below_min"
+        assert len(launcher.launched) == 1
+        assert router.active_replica_count() == 2
+
+
+# ---- decision surface ----------------------------------------------------
+
+
+class TestDecisionSurface:
+    def test_decision_event_carries_signals_and_gauges_track(self):
+        sink = _SinkSpy()
+        r0 = ScalableReplica("r0")
+        r0.set_occupancy(1.0)
+        router, scaler, _ = make_scaler(
+            [r0], AutoscalePolicy(**BAND), sink=sink
+        )
+        for now in (0.0, 5.0):
+            tick(router, scaler, now)
+        events = sink.of("autoscale_decision")
+        assert len(events) == 1
+        ev = events[0]
+        assert ev["decision"] == "scale_up"
+        assert ev["reason"] == "occupancy_high"
+        assert ev["delta"] == 1
+        assert ev["replicas_before"] == 1
+        assert ev["occupancy"] == 1.0
+        assert ev["sustained_s"] == 5.0
+        snap = router.federated_snapshot()
+        assert snap["fleet_replicas_desired"] == 2.0
+        assert snap["fleet_replicas_active"] == 2.0
+        assert snap["fleet_scale_up_total"] == 1.0
+        assert snap["fleet_scale_down_total"] == 0.0
+        st = scaler.status()
+        assert st["decisions_tail"][-1]["decision"] == "scale_up"
+        assert st["desired"] == 2
+        # A stopped autoscaler detaches its collector: frozen gauges
+        # must not outlive the control loop on the fleet registry.
+        scaler.stop()
+        assert "fleet_replicas_desired" not in router.federated_snapshot()
+
+
+# ---- RespawnBudget (the supervision bugfix) ------------------------------
+
+
+class TestRespawnBudget:
+    def budget(self, tries=3):
+        return RespawnBudget(
+            BackoffPolicy(
+                max_tries=tries, base_s=1.0, multiplier=2.0,
+                ceiling_s=30.0, jitter=0.0,
+            ),
+            reset_after_s=60.0,
+        )
+
+    def test_exhausts_after_max_tries_crash_loops(self):
+        b = self.budget(tries=3)
+        assert b.note_death(now=0.0) and not b.exhausted
+        assert b.note_death(now=1.0) and not b.exhausted
+        assert b.note_death(now=2.0) and not b.exhausted
+        # The fourth rapid death exceeds the budget: abandon the slot.
+        assert not b.note_death(now=3.0)
+        assert b.exhausted
+        assert not b.ready(now=1e9)  # never respawns again
+
+    def test_backoff_schedule_gates_ready(self):
+        b = self.budget(tries=5)
+        b.note_death(now=0.0)
+        assert not b.ready(now=0.5)  # base_s=1.0 not yet elapsed
+        assert b.ready(now=1.0)
+        b.note_death(now=1.0)  # second death: 2.0s delay
+        assert not b.ready(now=2.5)
+        assert b.ready(now=3.0)
+
+    def test_surviving_reset_window_restores_budget(self):
+        b = self.budget(tries=2)
+        b.note_death(now=0.0)
+        b.note_death(now=1.0)
+        assert b.deaths == 2
+        b.note_alive(now=100.0)  # survived 60s past the last death
+        assert b.deaths == 0
+        # A fresh crash loop gets the full budget again.
+        assert b.note_death(now=101.0) and b.deaths == 1
+
+
+# ---- zero-drop scale-down on real servers --------------------------------
+
+
+IMG = np.full((64, 64, 3), 7, np.uint8)
+
+
+def _make_live_fleet(sink=None, delay_s=0.0):
+    servers = [
+        DetectionServer(
+            StubDetectEngine(video=True, delay_s=delay_s),
+            ServeConfig(max_delay_ms=5, preprocess_workers=1),
+            replica_id=f"r{k}",
+        )
+        for k in range(2)
+    ]
+    replicas = [LocalReplica(s) for s in servers]
+    router = FleetRouter(
+        replicas,
+        FleetConfig(probe_backoff=EXACT_BACKOFF, poll_interval_s=0.05),
+        sink=sink,
+        auto_poll=False,
+    )
+    return router, servers, replicas
+
+
+class TestZeroDropScaleDown:
+    def test_inflight_on_victim_completes_and_router_redistributes(self):
+        router, servers, replicas = _make_live_fleet(delay_s=0.15)
+        launcher = LocalLauncher(
+            lambda rid: LocalReplica(
+                DetectionServer(
+                    StubDetectEngine(video=True),
+                    ServeConfig(max_delay_ms=5, preprocess_workers=1),
+                    replica_id=rid,
+                )
+            )
+        )
+        for r in replicas:
+            launcher.adopt(r)
+        pol = AutoscalePolicy(
+            min_replicas=1, max_replicas=2, occupancy_low=0.6,
+            occupancy_high=0.9, for_s=0.0, up_cooldown_s=0.0,
+            down_cooldown_s=0.0,
+        )
+        scaler = Autoscaler(router, pol, launcher)
+        victim = replicas[0]
+        results: list = []
+        errors: list = []
+
+        def call():
+            try:
+                results.append(victim.detect(IMG, timeout_s=30))
+            except Exception as exc:  # any drop/5xx fails the test
+                errors.append(exc)
+
+        threads = [
+            # watchdog: short-lived request threads the test joins below
+            threading.Thread(target=call, daemon=True) for _ in range(4)
+        ]
+        try:
+            for t in threads:
+                t.start()
+            # The poll sees the victim busy (lower weight than its idle
+            # peer); mean occupancy sits below the low mark, so the
+            # autoscaler drains EXACTLY the in-flight replica.
+            deadline = 50
+            fired = []
+            while not fired and deadline:
+                router.poll_once(now=0.0)
+                if any(
+                    r["load"].get("inflight")
+                    for r in router.status()["replicas"]
+                ):
+                    fired = scaler.check_once(now=0.0)
+                    break
+                deadline -= 1
+            assert fired and fired[0]["decision"] == "scale_down"
+            assert fired[0]["victims"] == ["r0"]
+            by_id = {
+                r["replica_id"]: r for r in router.status()["replicas"]
+            }
+            assert by_id["r0"]["state"] == "drained"
+            # New traffic redistributes to the survivor while the
+            # victim drains — nothing sheds, nothing errors.
+            assert router.detect(IMG, timeout_s=30) == \
+                router.detect(IMG, timeout_s=30)
+            for t in threads:
+                t.join(timeout=30)
+            assert not errors
+            assert len(results) == 4  # every in-flight request completed
+            # The reap is the BOUNDED drain: in-flight already zero, so
+            # the slot reclaims and the replica vanishes.
+            for now in (1.0, 2.0, 3.0):
+                scaler.check_once(now=now)
+                if "r0" not in {
+                    r["replica_id"] for r in router.status()["replicas"]
+                }:
+                    break
+            assert "r0" not in {
+                r["replica_id"] for r in router.status()["replicas"]
+            }
+        finally:
+            router.close()
+            for s in servers:
+                s.close()
+
+    def test_pinned_stream_on_victim_repins_once_zero_dropped(self):
+        sink = _SinkSpy()
+        router, servers, replicas = _make_live_fleet(sink=sink)
+        by_id = {r.replica_id: r for r in replicas}
+        launcher = LocalLauncher(lambda rid: None)
+        pol = AutoscalePolicy(
+            min_replicas=1, max_replicas=2, occupancy_low=0.6,
+            occupancy_high=0.9, for_s=0.0, up_cooldown_s=0.0,
+            down_cooldown_s=0.0,
+        )
+        scaler = Autoscaler(router, pol, launcher, sink=sink)
+        try:
+            opened = router.stream_open(width=64, height=64)
+            sid = opened["session"]
+            results = []
+            for seq in range(8):
+                dets, _hit = router.stream_frame(sid, seq, IMG)
+                results.append(dets)
+            # Own ONLY the pinned replica: the scale-down victim is the
+            # stream's home by construction.
+            launcher.adopt(by_id[opened["replica_id"]])
+            router.poll_once(now=0.0)
+            fired = scaler.check_once(now=0.0)
+            assert fired and fired[0]["victims"] == [opened["replica_id"]]
+            # Every later frame serves: ONE re-pin to the survivor.
+            for seq in range(8, 16):
+                dets, _hit = router.stream_frame(sid, seq, IMG)
+                results.append(dets)
+            assert len(results) == 16 and all(results)
+            repins = sink.of("stream_repinned")
+            assert len(repins) == 1
+            assert repins[0]["stream"] == sid
+            assert repins[0]["to_replica"] != opened["replica_id"]
+            assert router.status()["stream_repins"] == 1
+        finally:
+            router.close()
+            # Close the REPLICA handles, not the bare servers: both ends
+            # of the re-pin own a lazily-attached StreamManager whose
+            # delivery thread only replica.close() stops.
+            for r in replicas:
+                r.close()
+            for s in servers:
+                s.close()

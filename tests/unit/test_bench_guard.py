@@ -380,3 +380,57 @@ class TestServeBenchCheck:
         }
         assert bench.check_continuous_against_committed(fresh) == 1
         assert "diverged" in capsys.readouterr().out
+
+    def test_committed_autoscale_record_holds_the_contract(self):
+        """ISSUE 19: the committed autoscale leg must show the fleet
+        grew under the spike, drained back to min when the day quieted,
+        and no request was ever dropped across scaling."""
+        scale = self._committed().get("autoscale")
+        assert scale, "SERVEBENCH.json has no autoscale record"
+        assert scale["engine"] == "stub"  # device-independent comparison
+        assert scale["dropped"] == 0
+        assert scale["scaled_up"] >= 1 and scale["scaled_down"] >= 1
+        assert scale["peak_replicas"] >= 2
+        assert scale["final_replicas"] == scale["min_replicas"]
+        # Trajectory evidence: offered load monotone, replica count
+        # actually moved (the control loop lived through the day).
+        traj = scale["trajectory"]
+        assert len({int(s[2]) for s in traj}) >= 2
+
+    def _scale_fresh(self, **over):
+        fresh = {
+            "engine": "stub", "requests": 240, "completed": 240,
+            "shed": 0, "dropped": 0, "p99_ms": 150.0, "scaled_up": 2,
+            "scaled_down": 2, "peak_replicas": 3, "final_replicas": 1,
+            "min_replicas": 1, "max_replicas": 3,
+        }
+        fresh.update(over)
+        return fresh
+
+    def test_autoscale_check_bites_on_dropped_requests(self, capsys):
+        fresh = self._scale_fresh(dropped=3)
+        assert bench.check_autoscale_against_committed(fresh) == 1
+        assert "never resolved" in capsys.readouterr().out
+
+    def test_autoscale_check_bites_on_dead_control_loop(self, capsys):
+        fresh = self._scale_fresh(scaled_up=0, peak_replicas=1)
+        assert bench.check_autoscale_against_committed(fresh) == 1
+        assert "never scaled up" in capsys.readouterr().out
+
+    def test_autoscale_check_bites_on_stuck_fleet(self, capsys):
+        fresh = self._scale_fresh(final_replicas=3)
+        assert bench.check_autoscale_against_committed(fresh) == 1
+        assert "never returned to min" in capsys.readouterr().out
+
+    def test_autoscale_check_bites_on_p99_band(self, capsys):
+        committed = self._committed()["autoscale"]
+        fresh = self._scale_fresh(
+            p99_ms=committed["p99_ms"] * 10.0
+        )
+        assert bench.check_autoscale_against_committed(fresh) == 1
+        assert "latency not held" in capsys.readouterr().out
+
+    def test_autoscale_check_passes_on_healthy_fresh(self, capsys):
+        fresh = self._scale_fresh()
+        assert bench.check_autoscale_against_committed(fresh) == 0
+        assert "zero dropped: ok" in capsys.readouterr().out

@@ -52,6 +52,7 @@ from batchai_retinanet_horovod_coco_tpu.serve.stub import (
     drift_frames,
 )
 from batchai_retinanet_horovod_coco_tpu.utils.arrivals import (
+    diurnal_spike_schedule,
     mixed_arrival_schedule,
     multi_stream_schedule,
 )
@@ -538,6 +539,30 @@ class TestArrivals:
         assert a == b  # byte-identical, not merely close
         assert a != mixed_arrival_schedule(64, base_rate=50.0, seed=4)
         assert all(t1 > t0 for t0, t1 in zip(a, a[1:]))
+
+    def test_diurnal_spike_schedule_deterministic_per_seed(self):
+        a = diurnal_spike_schedule(256, base_rate=20.0, seed=7)
+        b = diurnal_spike_schedule(256, base_rate=20.0, seed=7)
+        assert a == b  # byte-identical, not merely close
+        assert a != diurnal_spike_schedule(256, base_rate=20.0, seed=8)
+        assert all(t1 > t0 for t0, t1 in zip(a, a[1:]))
+
+    def test_diurnal_spike_window_densifies_arrivals(self):
+        times = diurnal_spike_schedule(
+            4000, base_rate=50.0, seed=11, period_s=10.0,
+            amplitude=0.0, spikes=((0.5, 0.2, 4.0),),
+        )
+        # With the sinusoid flattened, arrival density inside the spike
+        # window (period fractions [0.4, 0.6]) must dominate an equal-
+        # width off-peak window — the 4x multiplier is visible.
+        frac = [(t % 10.0) / 10.0 for t in times]
+        in_spike = sum(1 for f in frac if 0.4 <= f <= 0.6)
+        off_peak = sum(1 for f in frac if 0.7 <= f <= 0.9)
+        assert in_spike > 2 * off_peak
+
+    def test_diurnal_amplitude_bounds_rejected(self):
+        with pytest.raises(ValueError, match="amplitude"):
+            diurnal_spike_schedule(8, base_rate=10.0, amplitude=1.0)
 
     def test_multi_stream_schedule_deterministic_and_ordered(self):
         a = multi_stream_schedule(3, 20, fps=30.0, seed=9)

@@ -124,8 +124,10 @@ canaries:
 # tiny virtual mesh — the slow leg, ~1 min of XLA compile).  Suppression
 # grammar: '# lint: <rule>: <why>' with a REQUIRED rationale.  Also runs
 # in tier-1 (tests/unit/test_lint.py::TestLiveTree).
+# --jobs 8: the per-file phase fans out over a thread pool (ISSUE 20);
+# the report is byte-identical to the serial run.
 lint:
-	python -m batchai_retinanet_horovod_coco_tpu.analysis
+	python -m batchai_retinanet_horovod_coco_tpu.analysis --jobs 8
 	python scripts/audit_threads.py
 	python scripts/audit_collectives.py --reduced --devices 2
 
@@ -159,10 +161,10 @@ numerics-smoke:
 # re-derives the stream position).  chaos-smoke is the bounded CI leg
 # (one mid-save kill + the NaN leg, ~4 subprocess runs).
 chaos:
-	JAX_PLATFORMS=cpu python scripts/chaos.py
+	JAX_PLATFORMS=cpu RETINANET_LOCK_DEBUG=1 python scripts/chaos.py
 
 chaos-smoke:
-	JAX_PLATFORMS=cpu python scripts/chaos.py --smoke
+	JAX_PLATFORMS=cpu RETINANET_LOCK_DEBUG=1 python scripts/chaos.py --smoke
 
 # COMMBENCH (ISSUE 13, bench.py --mode comm + scripts/commbench_sweep.py):
 # the gradient-compression subsystem's committed evidence — bytes-on-wire
@@ -185,7 +187,7 @@ commbench-check:
 # structured ef_reset event) and the losses rejoin the uninterrupted
 # baseline envelope.  Also part of the full `make chaos` schedule.
 chaos-comm:
-	JAX_PLATFORMS=cpu python scripts/chaos.py --comm
+	JAX_PLATFORMS=cpu RETINANET_LOCK_DEBUG=1 python scripts/chaos.py --comm
 
 # Serve-fleet chaos (ISSUE 12, scripts/chaos.py --serve): the REAL fleet
 # CLI over 2 stub-engine replica subprocesses — SIGKILL one mid-load and
@@ -197,7 +199,7 @@ chaos-comm:
 # ONE canary_rollback event with the fleet back at baseline weights.
 # CPU-only, no dataset — wired into check-static.
 fleet-smoke:
-	JAX_PLATFORMS=cpu python scripts/chaos.py --serve
+	JAX_PLATFORMS=cpu RETINANET_LOCK_DEBUG=1 python scripts/chaos.py --serve
 
 # Fleet observability smoke (ISSUE 15, scripts/fleet_obs_smoke.py): the
 # real fleet CLI + 2 stub replicas with --obs-trace on — SIGKILL one
@@ -209,7 +211,7 @@ fleet-smoke:
 # artifacts — the verdict must NAME the killed replica.  CPU-only, no
 # dataset — wired into check-static.
 fleet-obs-smoke:
-	JAX_PLATFORMS=cpu python scripts/fleet_obs_smoke.py
+	JAX_PLATFORMS=cpu RETINANET_LOCK_DEBUG=1 python scripts/fleet_obs_smoke.py
 
 # Streaming detection smoke (ISSUE 18, scripts/stream_smoke.py): the real
 # fleet CLI + 2 stub-video replicas — 3 seeded drift streams race
@@ -219,7 +221,7 @@ fleet-obs-smoke:
 # its streams with exactly one stream_repinned event and ZERO dropped
 # frames.  CPU-only, no dataset — wired into check-static.
 stream-smoke:
-	JAX_PLATFORMS=cpu python scripts/stream_smoke.py
+	JAX_PLATFORMS=cpu RETINANET_LOCK_DEBUG=1 python scripts/stream_smoke.py
 
 # Autoscaling smoke (ISSUE 19, scripts/chaos.py --autoscale): the seeded
 # diurnal/spike day against a real 1..3 autoscaling stub fleet — the
@@ -230,7 +232,7 @@ stream-smoke:
 # request's shed (demand_scale_from_zero) respawns capacity so the
 # client's retry lands.  CPU-only, no dataset — wired into check-static.
 scale-smoke:
-	JAX_PLATFORMS=cpu python scripts/chaos.py --autoscale
+	JAX_PLATFORMS=cpu RETINANET_LOCK_DEBUG=1 python scripts/chaos.py --autoscale
 
 # CKPTBENCH (ISSUE 11): the two durability numbers — async-save overhead
 # (wall of N checkpointed steps vs the same N without) and resume

@@ -30,22 +30,39 @@ def main(argv: list[str] | None = None) -> int:
                     help="baseline file (default: analysis/baseline.json)")
     ap.add_argument("--update-baseline", action="store_true",
                     help="rewrite the baseline from current findings")
+    ap.add_argument("--jobs", type=int, default=1, metavar="N",
+                    help="parse/lint files with an N-wide thread pool "
+                         "(report is byte-identical to serial)")
+    ap.add_argument("--update-lock-order", action="store_true",
+                    help="rewrite analysis/lock_order.json from the "
+                         "computed may-hold-while-acquiring edges")
     args = ap.parse_args(argv)
 
-    if args.update_baseline and args.rule:
+    if (args.update_baseline or args.update_lock_order) and args.rule:
         # A single-rule run sees only that rule's findings; rewriting the
         # baseline from it would silently drop every OTHER rule's
         # grandfathered entries and fail the next full run.
-        print("lint: --update-baseline requires a full run "
-              "(drop --rule)", file=sys.stderr)
+        print("lint: --update-baseline/--update-lock-order require a "
+              "full run (drop --rule)", file=sys.stderr)
         return 2
 
     try:
         report = engine.run(args.root, baseline_path=args.baseline,
-                            rule_names=args.rule)
+                            rule_names=args.rule, jobs=max(1, args.jobs))
     except ValueError as e:
         print(f"lint: {e}", file=sys.stderr)
         return 2
+    if args.update_lock_order:
+        from batchai_retinanet_horovod_coco_tpu.analysis.rules import (
+            lock_graph,
+        )
+
+        path = engine.default_lock_order_path(args.root)
+        edges = report["exports"].get("lock_order_edges", [])
+        lock_graph.write_lock_order(path, edges)
+        print(f"lint: lock order rewritten with {len(edges)} edge(s) "
+              f"-> {path}")
+        return 0
     if args.update_baseline:
         path = args.baseline or engine.default_baseline_path()
         engine.write_baseline(path, [

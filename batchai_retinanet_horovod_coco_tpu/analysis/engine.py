@@ -13,6 +13,18 @@ invariants are encoded against (the rules live in ``analysis/rules/``):
   returns ``Finding``s.  Rules are lexical AST passes: no imports of the
   linted code, no jax, stdlib only (this package must stay importable in
   jax-free processes, e.g. shm decode workers' CI checks).
+- **Project rules** (ISSUE 20) — rules registered via ``@register_project``
+  receive a ``ProjectContext`` (every parsed ``FileContext`` plus a
+  package-local import map and one-level call/attribute resolution) and may
+  reason ACROSS files: the lock-order deadlock detector, the
+  lock-held-blocking pass, and the event-vocabulary contract checker.
+  Cross-file findings carry a ``paths`` set and fingerprint on the SORTED
+  path set, so line/file drift in one member never churns the baseline key.
+- **Parse-once cache + ``--jobs N``** — files are parsed into a process-wide
+  cache keyed on (path, mtime, size); repeated runs (tier-1 runs the engine
+  several times) skip re-parsing, and the per-file phase fans out over a
+  thread pool.  Report output is byte-identical to the serial run: results
+  are re-assembled in the deterministic file-iteration order.
 - **Uniform suppression grammar** — ``# lint: <rule>[,<rule>...]: <why>``
   on the offending line or the line directly above it.  The rationale is
   REQUIRED non-empty: a suppression without a why, or naming an unknown
@@ -46,6 +58,7 @@ import io
 import json
 import os
 import re
+import threading
 import tokenize
 from collections import Counter
 from typing import Callable, Iterable
@@ -61,16 +74,31 @@ _SUPPRESS_RE = re.compile(
 
 @dataclasses.dataclass(frozen=True)
 class Finding:
-    """One rule violation at one site."""
+    """One rule violation at one site.
+
+    ``path``/``line`` anchor the finding (and its suppression comment) at
+    ONE site; a cross-file finding additionally carries ``paths`` — every
+    file involved — and fingerprints on the sorted path SET, so line drift
+    in one member file never churns a multi-file baseline entry."""
 
     rule: str
     path: str  # repo-relative (stable across checkouts; baseline key part)
     line: int  # 1-based; NOT part of the baseline key (lines drift)
     message: str
     snippet: str = ""  # stripped source line; the line-insensitive key part
+    paths: tuple[str, ...] = ()  # cross-file findings: the full path set
+
+    def __post_init__(self):
+        if not isinstance(self.paths, tuple):  # baseline round-trips lists
+            object.__setattr__(self, "paths", tuple(self.paths))
+
+    def path_key(self) -> str:
+        """The baseline path component: the sorted ``;``-joined path set
+        for cross-file findings, the single path otherwise."""
+        return ";".join(sorted(self.paths)) if self.paths else self.path
 
     def key(self) -> tuple[str, str, str]:
-        return (self.rule, self.path, self.snippet)
+        return (self.rule, self.path_key(), self.snippet)
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -104,6 +132,13 @@ class FileContext:
         self.stats: Counter = Counter()  # per-rule inspected-site counters
 
     # -- helpers rules share -------------------------------------------
+
+    def reset(self) -> None:
+        """Clear per-run mutable state (stats, suppression ``used`` flags)
+        so a cached parse can be reused by the next run."""
+        self.stats = Counter()
+        for sup in self.suppressions:
+            sup.used = False
 
     def snippet(self, line: int) -> str:
         if 1 <= line <= len(self.lines):
@@ -159,22 +194,129 @@ def _parse_suppressions(source: str, lines: list[str]) -> list[Suppression]:
 #: name -> (description, check(ctx) -> list[Finding])
 RULES: dict[str, tuple[str, Callable[[FileContext], list[Finding]]]] = {}
 
+#: name -> (description, check(project) -> list[Finding]) — whole-program
+#: passes that see every parsed file at once (ISSUE 20).
+PROJECT_RULES: dict[
+    str, tuple[str, Callable[["ProjectContext"], list[Finding]]]
+] = {}
+
 
 def register(name: str, description: str):
-    """Decorator: publish a rule under ``name`` in the registry."""
+    """Decorator: publish a per-file rule under ``name`` in the registry."""
 
     def deco(fn: Callable[[FileContext], list[Finding]]):
-        if name == SUPPRESSION_RULE:
-            raise ValueError(f"rule name {name!r} is reserved")
+        if name == SUPPRESSION_RULE or name in PROJECT_RULES:
+            raise ValueError(f"rule name {name!r} is reserved or taken")
         RULES[name] = (description, fn)
         return fn
 
     return deco
 
 
+def register_project(name: str, description: str):
+    """Decorator: publish a whole-program rule under ``name``."""
+
+    def deco(fn: Callable[["ProjectContext"], list[Finding]]):
+        if name == SUPPRESSION_RULE or name in RULES:
+            raise ValueError(f"rule name {name!r} is reserved or taken")
+        PROJECT_RULES[name] = (description, fn)
+        return fn
+
+    return deco
+
+
+def all_rule_names() -> list[str]:
+    return sorted(set(RULES) | set(PROJECT_RULES))
+
+
 def _ensure_rules_loaded() -> None:
     # Import for the registration side effect; cheap and idempotent.
     from batchai_retinanet_horovod_coco_tpu.analysis import rules  # noqa: F401
+
+
+# ---- project context -----------------------------------------------------
+
+
+class ProjectContext:
+    """Everything a project rule may look at: every parsed ``FileContext``,
+    a package-local import map, and one-level attribute/call resolution
+    helpers.  Rules share expensive intermediates (the lock graph) through
+    ``cache`` and surface machine-readable artifacts (the computed lock
+    order) through ``exports``, which ``run()`` folds into the report."""
+
+    def __init__(self, contexts: list[FileContext], root: str,
+                 lock_order_path: str | None = None):
+        self.root = root
+        self.contexts = list(contexts)
+        self.by_path: dict[str, FileContext] = {
+            c.relpath: c for c in self.contexts
+        }
+        self.lock_order_path = lock_order_path
+        self.stats: Counter = Counter()
+        self.cache: dict[str, object] = {}
+        self.exports: dict[str, object] = {}
+
+    def count(self, rule: str, n: int = 1) -> None:
+        self.stats[rule] += n
+
+    # -- package-local module naming / imports -------------------------
+
+    def module_name(self, ctx: FileContext) -> str | None:
+        """Dotted module path relative to the package root for in-package
+        files (``serve/fleet.py`` → ``serve.fleet``), None for scripts."""
+        if not ctx.in_package:
+            return None
+        rel = ctx.relpath.replace(os.sep, "/")
+        prefix = PACKAGE_NAME + "/"
+        if rel.startswith(prefix):
+            rel = rel[len(prefix):]
+        if rel.endswith("/__init__.py"):
+            rel = rel[: -len("/__init__.py")]
+        elif rel.endswith(".py"):
+            rel = rel[:-3]
+        return rel.replace("/", ".")
+
+    def context_for_module(self, dotted: str) -> FileContext | None:
+        """The FileContext behind a package-relative dotted module name."""
+        index = self.cache.get("_module_index")
+        if index is None:
+            index = {}
+            for c in self.contexts:
+                mod = self.module_name(c)
+                if mod is not None:
+                    index[mod] = c
+            self.cache["_module_index"] = index
+        return index.get(dotted)
+
+    def import_map(self, ctx: FileContext) -> dict[str, str]:
+        """Local name → package-relative dotted target for this file's
+        package-local imports: ``from ...serve import fleet`` → {'fleet':
+        'serve.fleet'}; ``from ...obs.trace import monotonic_s`` →
+        {'monotonic_s': 'obs.trace.monotonic_s'}.  Absolute package paths
+        only (the tree imports by absolute name throughout)."""
+        key = ("_imports", ctx.relpath)
+        cached = self.cache.get(key)
+        if cached is not None:
+            return cached  # type: ignore[return-value]
+        out: dict[str, str] = {}
+        prefix = PACKAGE_NAME + "."
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name.startswith(prefix):
+                        local = a.asname or a.name.split(".")[-1]
+                        out[local] = a.name[len(prefix):]
+            elif isinstance(node, ast.ImportFrom):
+                if not node.module or not node.module.startswith(
+                    PACKAGE_NAME
+                ):
+                    continue
+                base = node.module[len(PACKAGE_NAME):].lstrip(".")
+                for a in node.names:
+                    target = f"{base}.{a.name}" if base else a.name
+                    out[a.asname or a.name] = target
+        self.cache[key] = out
+        return out
 
 
 # ---- per-file run --------------------------------------------------------
@@ -192,29 +334,22 @@ def _validate_rule_names(rule_names: Iterable[str] | None) -> list[str]:
     """Resolve a rule selection to known names; raise on typos (a typo'd
     ``--rule`` must not die with a raw KeyError deep in the walk)."""
     if rule_names is None:
-        return sorted(RULES)
+        return all_rule_names()
     names = list(rule_names)
-    unknown = [n for n in names if n not in RULES]
+    unknown = [
+        n for n in names if n not in RULES and n not in PROJECT_RULES
+    ]
     if unknown:
         raise ValueError(
-            f"unknown rule(s) {unknown} (known: {sorted(RULES)})"
+            f"unknown rule(s) {unknown} (known: {all_rule_names()})"
         )
     return names
 
 
-def lint_source(path: str, relpath: str, source: str, *,
-                rule_names: Iterable[str] | None = None,
-                in_package: bool = True) -> FileResult:
-    """Run the (selected) rules over one file's source."""
-    _ensure_rules_loaded()
-    names = _validate_rule_names(rule_names)
-    try:
-        ctx = FileContext(path, relpath, source, in_package=in_package)
-    except SyntaxError as e:
-        f = Finding(rule=SUPPRESSION_RULE, path=relpath, line=e.lineno or 0,
-                    message=f"unparseable file: {e.msg}", snippet="")
-        return FileResult([f], [], [], [], Counter())
-
+def _validate_suppressions(
+    ctx: FileContext,
+) -> tuple[list[Finding], list[Suppression]]:
+    """Split parsed suppressions into grammar findings + valid comments."""
     grammar: list[Finding] = []
     valid: list[Suppression] = []
     for sup in ctx.suppressions:
@@ -226,22 +361,35 @@ def lint_source(path: str, relpath: str, source: str, *,
                 "requires a non-empty why",
             ))
             bad = True
-        unknown = [r for r in sup.rules if r not in RULES]
+        unknown = [
+            r for r in sup.rules
+            if r not in RULES and r not in PROJECT_RULES
+        ]
         if unknown:
             grammar.append(ctx.finding(
                 SUPPRESSION_RULE, sup.line,
                 f"suppression names unknown rule(s) {unknown} "
-                f"(known: {sorted(RULES)})",
+                f"(known: {all_rule_names()})",
             ))
             bad = True
         if not bad:
             valid.append(sup)
+    return grammar, valid
 
+
+def _lint_context(
+    ctx: FileContext, names: list[str],
+) -> tuple[list[Finding], list[Finding], list[Finding], list[Suppression]]:
+    """Per-file rules over one parsed context.  Returns (kept, suppressed,
+    grammar, valid_suppressions); ``unused`` is NOT computed here — project
+    rules may still consume a suppression later in the run."""
+    grammar, valid = _validate_suppressions(ctx)
     raw: list[Finding] = []
     for name in names:
+        if name not in RULES:  # project rules run later, on ProjectContext
+            continue
         _desc, fn = RULES[name]
         raw.extend(fn(ctx))
-
     kept: list[Finding] = []
     suppressed: list[Finding] = []
     for f in raw:
@@ -251,6 +399,23 @@ def lint_source(path: str, relpath: str, source: str, *,
             suppressed.append(f)
         else:
             kept.append(f)
+    return kept, suppressed, grammar, valid
+
+
+def lint_source(path: str, relpath: str, source: str, *,
+                rule_names: Iterable[str] | None = None,
+                in_package: bool = True) -> FileResult:
+    """Run the (selected) per-file rules over one file's source."""
+    _ensure_rules_loaded()
+    names = _validate_rule_names(rule_names)
+    try:
+        ctx = FileContext(path, relpath, source, in_package=in_package)
+    except SyntaxError as e:
+        f = Finding(rule=SUPPRESSION_RULE, path=relpath, line=e.lineno or 0,
+                    message=f"unparseable file: {e.msg}", snippet="")
+        return FileResult([f], [], [], [], Counter())
+
+    kept, suppressed, grammar, valid = _lint_context(ctx, names)
     unused = [s for s in valid if not s.used]
     return FileResult(kept, suppressed, grammar, unused, ctx.stats)
 
@@ -327,7 +492,7 @@ def write_baseline(path: str, findings: list[Finding]) -> None:
     )
 
     entries = sorted(
-        ({"rule": f.rule, "path": f.path, "snippet": f.snippet}
+        ({"rule": f.rule, "path": f.path_key(), "snippet": f.snippet}
          for f in findings),
         key=lambda e: (e["path"], e["rule"], e["snippet"]),
     )
@@ -338,38 +503,129 @@ def write_baseline(path: str, findings: list[Finding]) -> None:
     )
 
 
+# ---- parse cache ---------------------------------------------------------
+
+#: (abspath, mtime_ns, size) -> FileContext.  Parsing dominates wall time
+#: and tier-1 runs the engine several times in one process; a hit skips
+#: re-parsing (``ctx.reset()`` clears per-run mutable state).  Entries for
+#: a path are replaced on any stat change, so the cache never serves a
+#: stale tree.
+_CONTEXT_CACHE: dict[tuple[str, int, int], FileContext] = {}
+_CACHE_LOCK = threading.Lock()
+
+
+def _get_context(path: str, relpath: str, in_package: bool) -> FileContext:
+    """Parse ``path`` (or reuse the cached parse).  Raises SyntaxError."""
+    st = os.stat(path)
+    key = (os.path.abspath(path), st.st_mtime_ns, st.st_size)
+    with _CACHE_LOCK:
+        ctx = _CONTEXT_CACHE.get(key)
+    if ctx is not None and ctx.relpath == relpath:
+        ctx.reset()
+        return ctx
+    with open(path) as f:
+        source = f.read()
+    ctx = FileContext(path, relpath, source, in_package=in_package)
+    with _CACHE_LOCK:
+        # Drop any older snapshot of the same path before inserting.
+        for k in [k for k in _CONTEXT_CACHE
+                  if k[0] == key[0] and k != key]:
+            del _CONTEXT_CACHE[k]
+        _CONTEXT_CACHE[key] = ctx
+    return ctx
+
+
 # ---- whole-run driver ----------------------------------------------------
 
+def default_lock_order_path(root: str | None = None) -> str:
+    """The committed static lock order lives next to ``baseline.json`` —
+    resolved relative to the scanned root so fixture trees get their own
+    (usually absent) file instead of the live one."""
+    if root is None:
+        return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "lock_order.json")
+    return os.path.join(root, PACKAGE_NAME, "analysis", "lock_order.json")
+
+
 def run(root: str | None = None, *, baseline_path: str | None = None,
-        rule_names: Iterable[str] | None = None) -> dict:
+        rule_names: Iterable[str] | None = None, jobs: int = 1,
+        lock_order_path: str | None = None) -> dict:
     """Lint the tree, split findings against the baseline, return the
-    report object (the ``--json`` payload)."""
+    report object (the ``--json`` payload).
+
+    Phases: parse every file (``jobs`` wide; results assembled in the
+    deterministic iteration order, so the report is byte-identical to a
+    serial run), run per-file rules, then build one ``ProjectContext`` and
+    run the whole-program rules, then match suppressions and split against
+    the baseline."""
     _ensure_rules_loaded()
-    _validate_rule_names(rule_names)
+    names = _validate_rule_names(rule_names)
     root = root or repo_root()
     baseline_path = baseline_path or default_baseline_path()
+    lock_order_path = lock_order_path or default_lock_order_path(root)
     baseline = load_baseline(baseline_path)
+
+    targets = list(iter_target_files(root))
+    files_scanned = len(targets)
+
+    def _one(target):
+        path, relpath, in_pkg = target
+        try:
+            ctx = _get_context(path, relpath, in_pkg)
+        except SyntaxError as e:
+            f = Finding(rule=SUPPRESSION_RULE, path=relpath,
+                        line=e.lineno or 0,
+                        message=f"unparseable file: {e.msg}", snippet="")
+            return None, ([f], [], [], [])
+        return ctx, _lint_context(ctx, names)
+
+    if jobs > 1 and len(targets) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        # watchdog: bounded-lifetime CLI pool — `with` joins every worker
+        # before run() returns; nothing long-lived to heartbeat.
+        with ThreadPoolExecutor(max_workers=jobs) as pool:
+            results = list(pool.map(_one, targets))
+    else:
+        results = [_one(t) for t in targets]
 
     findings: list[Finding] = []
     suppressed: list[Finding] = []
     grammar: list[Finding] = []
-    unused: list[dict] = []
     stats: Counter = Counter()
-    files_scanned = 0
-    for path, relpath, in_pkg in iter_target_files(root):
-        with open(path) as f:
-            source = f.read()
-        res = lint_source(path, relpath, source, rule_names=rule_names,
-                          in_package=in_pkg)
-        files_scanned += 1
-        findings.extend(res.findings)
-        suppressed.extend(res.suppressed)
-        grammar.extend(res.grammar_findings)
-        stats.update(res.stats)
+    contexts: list[FileContext] = []
+    valid_by_path: dict[str, list[Suppression]] = {}
+    for ctx, (kept, supd, gram, valid) in results:
+        findings.extend(kept)
+        suppressed.extend(supd)
+        grammar.extend(gram)
+        if ctx is not None:
+            stats.update(ctx.stats)
+            contexts.append(ctx)
+            valid_by_path[ctx.relpath] = valid
+
+    # Whole-program rules: one ProjectContext over every parsed file,
+    # run serially (they share cached intermediates).  A project finding
+    # anchors at one (path, line) and honours that file's suppressions.
+    project_names = [n for n in names if n in PROJECT_RULES]
+    pctx = ProjectContext(contexts, root, lock_order_path=lock_order_path)
+    for name in project_names:
+        _desc, fn = PROJECT_RULES[name]
+        for f in fn(pctx):
+            sup = _match_suppression(valid_by_path.get(f.path, []), f)
+            if sup is not None:
+                sup.used = True
+                suppressed.append(f)
+            else:
+                findings.append(f)
+    stats.update(pctx.stats)
+
+    unused: list[dict] = []
+    for ctx in contexts:
         unused.extend(
-            {"path": relpath, "line": s.line, "rules": list(s.rules),
+            {"path": ctx.relpath, "line": s.line, "rules": list(s.rules),
              "why": s.why}
-            for s in res.unused_suppressions
+            for s in valid_by_path.get(ctx.relpath, []) if not s.used
         )
 
     # Bad suppression comments are never baselinable: they fail outright.
@@ -388,7 +644,7 @@ def run(root: str | None = None, *, baseline_path: str | None = None,
     ]
     return {
         "root": root,
-        "rules": sorted(rule_names) if rule_names else sorted(RULES),
+        "rules": sorted(names),
         "files_scanned": files_scanned,
         "stats": dict(sorted(stats.items())),
         "findings": [f.to_dict() for f in findings],
@@ -397,5 +653,6 @@ def run(root: str | None = None, *, baseline_path: str | None = None,
         "stale_baseline": stale,
         "suppressed": [f.to_dict() for f in suppressed],
         "unused_suppressions": unused,
+        "exports": pctx.exports,
         "ok": not new and not grammar and not stale,
     }

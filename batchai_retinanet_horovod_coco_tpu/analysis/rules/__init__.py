@@ -5,7 +5,9 @@ from batchai_retinanet_horovod_coco_tpu.analysis.rules import (  # noqa: F401
     atomic_artifacts,
     bounded_queues,
     collective_safety,
+    event_vocabulary,
     jit_purity,
+    lock_graph,
     monotonic_clock,
     thread_error_contract,
     watchdog_coverage,

@@ -11,10 +11,16 @@ This rule finds the functions passed to ``jax.jit`` / ``shard_map`` (as
 call arguments, decorators, or ``functools.partial(jax.jit, ...)``
 decorators), resolves them lexically within the file (named defs, methods,
 lambdas), and flags host-effect calls anywhere in the resolved body
-(nested defs included).  ``jax.debug.print``/``jax.debug.callback`` are the
-sanctioned in-jit effects and are not flagged.  Cross-module callees are
-out of scope (lexical pass).  Suppress with ``# lint: jit-purity: <why>``
-on the offending line (e.g. an intentional trace-time log).
+(nested defs included).  ``jax.debug.print``/``jax.debug.callback`` are
+sanctioned in-jit effects and are not flagged; so are the arguments of
+``jax.pure_callback`` / ``jax.experimental.io_callback`` calls — those are
+THE supported host-escape hatches, so their callback subtrees are exempt
+(ISSUE 20).  A ``functools.lru_cache`` / ``functools.cache`` decorator on
+a jit-handed function is flagged too: the cache keys on tracer OBJECTS, so
+every trace misses and the cache retains tracers — a silent leak.
+Cross-module callees are out of scope (lexical pass).  Suppress with
+``# lint: jit-purity: <why>`` on the offending line (e.g. an intentional
+trace-time log).
 """
 
 from __future__ import annotations
@@ -41,6 +47,17 @@ _BANNED_BUILTINS = frozenset({"print", "input", "breakpoint", "open"})
 _BANNED_TIME = frozenset({"time", "monotonic", "perf_counter", "sleep",
                           "time_ns", "monotonic_ns"})
 _HOST_SYNC_METHODS = frozenset({"item"})
+#: The sanctioned host-escape hatches: host effects inside the callback
+#: handed to these run OUTSIDE the trace, by design.
+_CALLBACK_NAMES = frozenset({"pure_callback", "io_callback"})
+#: Tracer-keyed memoization on a traced function: silent leak.
+_CACHE_DECORATORS = frozenset({"lru_cache", "cache"})
+
+
+def _is_callback_call(node: ast.Call) -> bool:
+    """``jax.pure_callback(...)`` / ``jax.experimental.io_callback(...)``
+    (bare from-imported names accepted too)."""
+    return callee_name(node) in _CALLBACK_NAMES
 
 
 def _is_jit_ref(expr: ast.expr) -> bool:
@@ -80,10 +97,24 @@ def _jit_entry_targets(tree: ast.AST):
                         yield node.lineno, node
 
 
+def _walk_sanctioned(fn: ast.AST):
+    """``ast.walk`` that skips the subtrees of sanctioned host-escape
+    calls (``jax.pure_callback`` / ``io_callback``): the callback and its
+    arguments are host-side by contract."""
+    stack = [fn]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.Call) and _is_callback_call(child):
+                continue
+            stack.append(child)
+
+
 def _banned_calls(fn: ast.AST, np_aliases: set[str],
                   random_aliases: set[str]):
     """Yield (lineno, description) for host-effect calls in the body."""
-    for node in ast.walk(fn):
+    for node in _walk_sanctioned(fn):
         if not isinstance(node, ast.Call):
             continue
         f = node.func
@@ -125,6 +156,21 @@ def check(ctx: FileContext) -> list[Finding]:
             continue  # cross-module callee — lexically out of scope
         ctx.count(NAME)
         fname = getattr(fn, "name", "<lambda>")
+        for dec in getattr(fn, "decorator_list", []):
+            dec_ref = dec.func if isinstance(dec, ast.Call) else dec
+            dec_name = (dec_ref.attr if isinstance(dec_ref, ast.Attribute)
+                        else dec_ref.id if isinstance(dec_ref, ast.Name)
+                        else None)
+            if dec_name in _CACHE_DECORATORS and \
+                    (dec.lineno, 0) not in seen:
+                seen.add((dec.lineno, 0))
+                out.append(ctx.finding(
+                    NAME, dec.lineno,
+                    f"functools.{dec_name} on jit-compiled '{fname}' "
+                    f"(jit entry at line {site_line}) — the cache keys on "
+                    "tracer objects, so it never hits and retains tracers "
+                    "(silent leak); memoize outside the traced function",
+                ))
         for lineno, desc in _banned_calls(fn, np_aliases, random_aliases):
             if (id(fn), lineno) in seen:
                 continue

@@ -1,0 +1,767 @@
+"""Whole-program concurrency analysis (ISSUE 20): the may-hold-while-
+acquiring graph and the two project rules built on it.
+
+``lock-order`` — every lock in the tree gets a stable dotted identity
+(``serve.fleet.FleetRouter._lock`` for ``self._lock = threading.Lock()``
+inside ``FleetRouter``; the literal name for ``utils.locks.make_lock("x")``
+sites).  Every ``with <lock>:`` / ``.acquire()`` scope contributes edges
+*held → acquired* for the locks taken inside it — including, one call level
+deep, the locks taken by package-local callees invoked from inside the
+scope.  Any cycle in that graph is a potential deadlock and is reported
+with every acquisition chain named.  The acyclic edge set is committed as
+``analysis/lock_order.json`` under the same non-growing discipline as
+``baseline.json``: a computed edge missing from the committed file fails
+(review-visible ``--update-lock-order`` to accept), and a committed edge
+no longer computed fails as stale.  The committed order is also what the
+``utils/locks.py`` runtime witness enforces under ``RETINANET_LOCK_DEBUG=1``.
+
+``lock-held-blocking`` — flags blocking operations performed while any
+lock is held: ``Queue.get/put`` with no timeout, zero-arg ``.join()`` /
+``.wait()`` / ``.result()``, ``time.sleep``, socket operations, HTTP
+fetches, and ``subprocess`` waits.  Each finding names the full hold-site →
+(call chain) → blocking-site path.
+
+Both rules are best-effort lexical passes with ONE level of call/attribute
+resolution — they over-approximate may-hold (a suppression with rationale
+is the escape hatch) and under-approximate aliasing (a lock smuggled
+through an untyped parameter is invisible).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+
+from batchai_retinanet_horovod_coco_tpu.analysis.engine import (
+    Finding,
+    FileContext,
+    ProjectContext,
+    register_project,
+)
+from batchai_retinanet_horovod_coco_tpu.analysis.rules.common import (
+    callee_name,
+    dotted,
+)
+
+RULE_ORDER = "lock-order"
+RULE_BLOCKING = "lock-held-blocking"
+
+#: Constructors that create a lock-like object.  Condition shares the
+#: identity of the lock it wraps when given one; a bare Condition() is its
+#: own identity (it owns a private RLock).
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_MAKE_LOCK = {"make_lock", "make_rlock"}
+
+
+# ---- data model ----------------------------------------------------------
+
+
+@dataclasses.dataclass
+class LockDef:
+    """One lock object with a stable dotted identity."""
+
+    identity: str
+    relpath: str
+    line: int
+    kind: str  # "Lock" | "RLock" | "Condition" | "named"
+
+
+@dataclasses.dataclass
+class Acq:
+    """A direct acquisition event inside one function."""
+
+    identity: str
+    line: int
+
+
+@dataclasses.dataclass
+class Blocking:
+    """A direct potentially-blocking call inside one function."""
+
+    desc: str
+    line: int
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """Per-function facts used for one-level call resolution."""
+
+    qual: str  # "Class.method" or "func"
+    module: str
+    relpath: str
+    node: ast.AST
+    cls: str | None
+    direct_acquires: list[Acq] = dataclasses.field(default_factory=list)
+    direct_blocking: list[Blocking] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass(frozen=True)
+class Edge:
+    src: str  # held
+    dst: str  # acquired while held
+
+
+@dataclasses.dataclass
+class Evidence:
+    relpath: str
+    line: int
+    holder: str  # qualified function where src is held
+    via: str  # "" for direct, "call <name>()" for one-level
+
+
+class LockGraph:
+    """The shared intermediate both rules (and ``--update-lock-order``)
+    consume; built once per run and cached on ``ProjectContext``."""
+
+    def __init__(self):
+        self.locks: dict[str, LockDef] = {}
+        # (module, cls-or-None, attr) -> identity
+        self.table: dict[tuple[str, str | None, str], str] = {}
+        # (module, cls, attr) -> dotted class name of the attribute value
+        # (for one-level self.pool._lock resolution)
+        self.attr_types: dict[tuple[str, str, str], str] = {}
+        # (module, qual) -> FuncInfo
+        self.funcs: dict[tuple[str, str], FuncInfo] = {}
+        # classes per module (for resolving ClassName(...) construction)
+        self.classes: dict[str, set[str]] = {}
+        self.edges: dict[Edge, list[Evidence]] = {}
+        self.blocking: list[tuple[str, Evidence, str]] = []
+        #: acquisition sites actually resolved to an identity
+        self.sites = 0
+        #: calls inspected while >=1 lock held (blocking-rule coverage)
+        self.calls_inspected = 0
+
+    def add_edge(self, e: Edge, ev: Evidence) -> None:
+        if e.src == e.dst:
+            return  # RLock reentry / over-approximated aliasing
+        self.edges.setdefault(e, []).append(ev)
+
+
+def module_of(pctx: ProjectContext, ctx: FileContext) -> str:
+    """Dotted module for in-package files; path-derived pseudo-module for
+    scripts (``scripts/chaos.py`` → ``scripts.chaos``)."""
+    mod = pctx.module_name(ctx)
+    if mod is not None:
+        return mod
+    rel = ctx.relpath.replace(os.sep, "/")
+    if rel.endswith(".py"):
+        rel = rel[:-3]
+    return rel.replace("/", ".")
+
+
+# ---- pass 1: lock definitions, attribute types, function index ----------
+
+
+def _lock_kind(call: ast.Call) -> str | None:
+    name = callee_name(call)
+    if name in _LOCK_CTORS:
+        d = dotted(call.func)
+        # Accept bare Lock() and threading.Lock(); reject foo.Lock() from
+        # unrelated modules only when the base is clearly not threading.
+        if d is None or d == name or d == f"threading.{name}":
+            return name
+    if name in _MAKE_LOCK:
+        return "named"
+    return None
+
+
+def _named_identity(call: ast.Call) -> str | None:
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    return None
+
+
+def _scan_file(graph: LockGraph, pctx: ProjectContext,
+               ctx: FileContext) -> None:
+    mod = module_of(pctx, ctx)
+    graph.classes.setdefault(mod, set())
+
+    def record_lock(key: tuple[str, str | None, str], call: ast.Call,
+                    default_identity: str) -> None:
+        kind = _lock_kind(call)
+        if kind is None:
+            return
+        if kind == "named":
+            identity = _named_identity(call) or default_identity
+        elif kind == "Condition" and call.args:
+            # Condition(wrapping_lock): share the wrapped lock's identity
+            # when it resolves, else own identity.
+            inner = _resolve_lock_expr(
+                graph, mod, key[1], call.args[0], local=None)
+            identity = inner or default_identity
+        else:
+            identity = default_identity
+        graph.table[key] = identity
+        graph.locks.setdefault(identity, LockDef(
+            identity=identity, relpath=ctx.relpath, line=call.lineno,
+            kind=kind))
+
+    def scan_assign(node: ast.stmt, cls: str | None) -> None:
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            return
+        value = node.value
+        if not isinstance(value, ast.Call):
+            return
+        targets = node.targets if isinstance(node, ast.Assign) \
+            else [node.target]
+        for t in targets:
+            if isinstance(t, ast.Name):
+                key = (mod, cls, t.id)
+                record_lock(key, value, f"{mod}.{cls + '.' if cls else ''}"
+                                        f"{t.id}")
+            elif isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and t.value.id == "self" \
+                    and cls is not None:
+                key = (mod, cls, t.attr)
+                record_lock(key, value, f"{mod}.{cls}.{t.attr}")
+                # Remember the constructed type of plain attributes for
+                # one-level self.<attr>.<lock> resolution.
+                if _lock_kind(value) is None:
+                    ctor = dotted(value.func)
+                    if ctor:
+                        graph.attr_types[(mod, cls, t.attr)] = ctor
+
+    def scan_body(body: list[ast.stmt], cls: str | None,
+                  prefix: str) -> None:
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                graph.classes[mod].add(node.name)
+                scan_body(node.body, node.name, prefix)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{cls}.{node.name}" if cls else node.name
+                graph.funcs[(mod, qual)] = FuncInfo(
+                    qual=qual, module=mod, relpath=ctx.relpath,
+                    node=node, cls=cls)
+                for sub in ast.walk(node):
+                    scan_assign(sub, cls)
+            else:
+                scan_assign(node, cls)
+                # module-level `if` guards etc.
+                for sub in ast.iter_child_nodes(node):
+                    if isinstance(sub, ast.stmt):
+                        scan_assign(sub, cls)
+
+    scan_body(ctx.tree.body, None, mod)
+
+
+# ---- lock-expression resolution ------------------------------------------
+
+
+def _resolve_lock_expr(graph: LockGraph, mod: str, cls: str | None,
+                       expr: ast.expr,
+                       local: dict[str, str] | None,
+                       imports: dict[str, str] | None = None) -> str | None:
+    """Map the expression in ``with <expr>:`` / ``<expr>.acquire()`` to a
+    lock identity, or None when it cannot be resolved."""
+    if isinstance(expr, ast.Name):
+        if local and expr.id in local:
+            return local[expr.id]
+        hit = graph.table.get((mod, cls, expr.id)) \
+            or graph.table.get((mod, None, expr.id))
+        if hit:
+            return hit
+        if imports:
+            target = imports.get(expr.id)
+            if target and "." in target:
+                m, n = target.rsplit(".", 1)
+                return graph.table.get((m, None, n))
+        return None
+    if isinstance(expr, ast.Attribute):
+        base = expr.value
+        if isinstance(base, ast.Name):
+            if base.id in ("self", "cls") and cls is not None:
+                return graph.table.get((mod, cls, expr.attr))
+            # class-qualified: Frontend._stream_lock in the same module
+            if base.id in graph.classes.get(mod, ()):
+                return graph.table.get((mod, base.id, expr.attr))
+            # module alias: fleet._LOCK after `from ..serve import fleet`
+            if imports:
+                target = imports.get(base.id)
+                if target:
+                    return graph.table.get((target, None, expr.attr)) \
+                        or graph.table.get((target, base.id, expr.attr))
+            return None
+        if isinstance(base, ast.Attribute) and \
+                isinstance(base.value, ast.Name) and \
+                base.value.id == "self" and cls is not None:
+            # ONE level: self.<attr>.<lock> through the attr's known type.
+            ctor = graph.attr_types.get((mod, cls, base.attr))
+            if ctor:
+                owner_mod, owner_cls = _resolve_class(
+                    graph, mod, ctor, imports)
+                if owner_cls:
+                    return graph.table.get(
+                        (owner_mod, owner_cls, expr.attr))
+    return None
+
+
+def _resolve_class(graph: LockGraph, mod: str, ctor: str,
+                   imports: dict[str, str] | None
+                   ) -> tuple[str, str | None]:
+    """``SlotPool`` / ``batcher.SlotPool`` → (defining module, class)."""
+    if "." in ctor:
+        head, cls = ctor.rsplit(".", 1)
+        target = (imports or {}).get(head, head)
+        if cls in graph.classes.get(target, ()):
+            return target, cls
+        return target, None
+    if ctor in graph.classes.get(mod, ()):
+        return mod, ctor
+    target = (imports or {}).get(ctor)
+    if target and "." in target:
+        m, cls = target.rsplit(".", 1)
+        if cls in graph.classes.get(m, ()):
+            return m, cls
+    return mod, None
+
+
+# ---- pass 2: per-function direct acquisitions / blocking calls -----------
+
+
+def _walk_pruned(node: ast.AST):
+    """``ast.walk`` that does NOT descend into nested function/class/lambda
+    bodies (their statements execute elsewhere)."""
+    stack = list(ast.iter_child_nodes(node))
+    yield node
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda, ast.ClassDef)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+_BLOCKING_DOTTED = ("time.sleep", "urllib.request.urlopen", "urlopen")
+_BLOCKING_PREFIXES = ("subprocess.", "requests.", "socket.")
+_SUBPROCESS_FNS = {"run", "call", "check_call", "check_output"}
+_SOCKET_METHODS = {"recv", "recv_into", "accept", "connect", "sendall"}
+
+
+def _has_kw(call: ast.Call, *names: str) -> bool:
+    return any(kw.arg in names for kw in call.keywords)
+
+
+def _blocking_desc(call: ast.Call, time_aliases: set[str]) -> str | None:
+    """Classify one call as potentially blocking, or None."""
+    d = dotted(call.func)
+    name = callee_name(call)
+    if d in _BLOCKING_DOTTED or (d and d.split(".", 1)[0] in time_aliases
+                                 and name == "sleep"):
+        return f"`{d}(...)`"
+    if d:
+        head = d.split(".", 1)[0] + "."
+        if head in _BLOCKING_PREFIXES:
+            if head == "subprocess." and name not in (
+                    _SUBPROCESS_FNS | {"Popen"}):
+                return None
+            if _has_kw(call, "timeout"):
+                return None
+            return f"`{d}(...)`"
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    # Method-shape heuristics: zero-arg join/wait/result, no-timeout
+    # queue get/put, socket methods, subprocess handle waits.
+    if name == "join" and not call.args and not _has_kw(call, "timeout"):
+        return "`.join()` with no timeout"
+    if name == "result" and not call.args and not _has_kw(call, "timeout"):
+        return "`.result()` with no timeout"
+    if name == "communicate" and not _has_kw(call, "timeout"):
+        return "`.communicate()` with no timeout"
+    if name == "get" and not call.args and not _has_kw(
+            call, "timeout", "block"):
+        return "`.get()` with no timeout"
+    if name == "put" and len(call.args) == 1 and not _has_kw(
+            call, "timeout", "block"):
+        return "`.put(...)` with no timeout"
+    if name in _SOCKET_METHODS and not _has_kw(call, "timeout"):
+        return f"`.{name}(...)` (socket)"
+    return None
+
+
+def _with_lock_items(graph: LockGraph, fi: FuncInfo, node: ast.With,
+                     local: dict[str, str],
+                     imports: dict[str, str]) -> list[tuple[str, int]]:
+    out = []
+    for item in node.items:
+        ident = _resolve_lock_expr(graph, fi.module, fi.cls,
+                                   item.context_expr, local, imports)
+        if ident:
+            out.append((ident, item.context_expr.lineno))
+    return out
+
+
+def _acquire_target(graph: LockGraph, fi: FuncInfo, call: ast.Call,
+                    local: dict[str, str],
+                    imports: dict[str, str]) -> str | None:
+    if isinstance(call.func, ast.Attribute) and \
+            call.func.attr in ("acquire", "release"):
+        return _resolve_lock_expr(graph, fi.module, fi.cls,
+                                  call.func.value, local, imports)
+    return None
+
+
+def _local_lock_defs(node: ast.stmt, mod: str, qual: str,
+                     local: dict[str, str]) -> None:
+    """Track function-local ``lk = threading.Lock()`` / ``make_lock(...)``
+    bindings so later ``with lk:`` resolves."""
+    if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+        kind = _lock_kind(node.value)
+        if kind is not None:
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    ident = (_named_identity(node.value)
+                             if kind == "named" else None)
+                    local[t.id] = ident or f"{mod}.{qual}.{t.id}"
+
+
+def _pass_direct(graph: LockGraph, pctx: ProjectContext) -> None:
+    """Fill every FuncInfo's direct acquisitions and blocking calls."""
+    for (mod, qual), fi in graph.funcs.items():
+        ctx = pctx.by_path.get(fi.relpath)
+        imports = pctx.import_map(ctx) if ctx is not None else {}
+        time_aliases = {"time"}
+        local: dict[str, str] = {}
+        for node in _walk_pruned(fi.node):
+            if node is fi.node:
+                continue
+            if isinstance(node, ast.stmt):
+                _local_lock_defs(node, mod, qual, local)
+            if isinstance(node, ast.With):
+                for ident, line in _with_lock_items(
+                        graph, fi, node, local, imports):
+                    fi.direct_acquires.append(Acq(ident, line))
+            elif isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "acquire":
+                    ident = _acquire_target(graph, fi, node, local, imports)
+                    if ident:
+                        fi.direct_acquires.append(Acq(ident, node.lineno))
+                desc = _blocking_desc(node, time_aliases)
+                if desc:
+                    fi.direct_blocking.append(Blocking(desc, node.lineno))
+
+
+# ---- pass 3: held-scope walk → edges + blocking findings -----------------
+
+
+def _resolve_call(graph: LockGraph, fi: FuncInfo, call: ast.Call,
+                  imports: dict[str, str]) -> FuncInfo | None:
+    """ONE level of package-local call resolution."""
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        target = graph.funcs.get((fi.module, fn.id))
+        if target:
+            return target
+        imp = imports.get(fn.id)
+        if imp and "." in imp:
+            m, n = imp.rsplit(".", 1)
+            return graph.funcs.get((m, n))
+        return None
+    if isinstance(fn, ast.Attribute):
+        base = fn.value
+        if isinstance(base, ast.Name):
+            if base.id in ("self", "cls") and fi.cls is not None:
+                return graph.funcs.get((fi.module,
+                                        f"{fi.cls}.{fn.attr}"))
+            imp = imports.get(base.id)
+            if imp:
+                return graph.funcs.get((imp, fn.attr))
+        elif isinstance(base, ast.Attribute) and \
+                isinstance(base.value, ast.Name) and \
+                base.value.id == "self" and fi.cls is not None:
+            ctor = graph.attr_types.get((fi.module, fi.cls, base.attr))
+            if ctor:
+                m, cls = _resolve_class(graph, fi.module, ctor, imports)
+                if cls:
+                    return graph.funcs.get((m, f"{cls}.{fn.attr}"))
+    return None
+
+
+def _pass_scopes(graph: LockGraph, pctx: ProjectContext) -> None:
+    for (mod, qual), fi in graph.funcs.items():
+        ctx = pctx.by_path.get(fi.relpath)
+        imports = pctx.import_map(ctx) if ctx is not None else {}
+        time_aliases = {"time"}
+        local: dict[str, str] = {}
+
+        def scan_expr(expr: ast.AST,
+                      held: tuple[tuple[str, int], ...],
+                      explicit: list[tuple[str, int]]) -> None:
+            """Calls inside one expression (no nested statements here)."""
+            for sub in _walk_pruned(expr):
+                if not isinstance(sub, ast.Call):
+                    continue
+                all_held = held + tuple(explicit)
+                if isinstance(sub.func, ast.Attribute) and \
+                        sub.func.attr in ("acquire", "release"):
+                    ident = _acquire_target(graph, fi, sub, local, imports)
+                    if ident:
+                        if sub.func.attr == "acquire":
+                            graph.sites += 1
+                            for src, _l in all_held:
+                                graph.add_edge(
+                                    Edge(src, ident),
+                                    Evidence(fi.relpath, sub.lineno,
+                                             f"{mod}.{qual}", ""))
+                            explicit.append((ident, sub.lineno))
+                        else:
+                            for i in range(len(explicit) - 1, -1, -1):
+                                if explicit[i][0] == ident:
+                                    del explicit[i]
+                                    break
+                    continue
+                if not all_held:
+                    continue
+                graph.calls_inspected += 1
+                inner, inner_line = all_held[-1]
+                hold = f"{inner} (acquired {fi.relpath}:{inner_line})"
+                desc = _blocking_desc(sub, time_aliases)
+                if desc is not None:
+                    graph.blocking.append((desc, Evidence(
+                        fi.relpath, sub.lineno, f"{mod}.{qual}", ""),
+                        hold))
+                    continue
+                callee = _resolve_call(graph, fi, sub, imports)
+                if callee is None or callee is fi:
+                    continue
+                for acq in callee.direct_acquires:
+                    for src, _l in all_held:
+                        graph.add_edge(Edge(src, acq.identity), Evidence(
+                            fi.relpath, sub.lineno, f"{mod}.{qual}",
+                            f"call {callee.module}.{callee.qual}() "
+                            f"acquires at {callee.relpath}:{acq.line}"))
+                for blk in callee.direct_blocking:
+                    graph.blocking.append((blk.desc, Evidence(
+                        fi.relpath, sub.lineno, f"{mod}.{qual}",
+                        f"via {callee.module}.{callee.qual}() at "
+                        f"{callee.relpath}:{blk.line}"), hold))
+
+        def visit(stmts: list[ast.stmt],
+                  held: tuple[tuple[str, int], ...],
+                  explicit: list[tuple[str, int]]) -> None:
+            # ``held`` = with-stack; ``explicit`` = live .acquire() holds.
+            for node in stmts:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.ClassDef)):
+                    continue
+                _local_lock_defs(node, mod, qual, local)
+                if isinstance(node, (ast.With, ast.AsyncWith)):
+                    acquired = _with_lock_items(
+                        graph, fi, node, local, imports)
+                    graph.sites += len(acquired)
+                    # ``with a, b:`` acquires sequentially — items earlier
+                    # in the same statement are held when later ones are
+                    # taken, so they contribute edges too.
+                    running = list(held + tuple(explicit))
+                    for ident, line in acquired:
+                        for src, _src_line in running:
+                            graph.add_edge(Edge(src, ident), Evidence(
+                                fi.relpath, line, f"{mod}.{qual}", ""))
+                        running.append((ident, line))
+                    for item in node.items:
+                        if not _resolve_lock_expr(
+                                graph, fi.module, fi.cls,
+                                item.context_expr, local, imports):
+                            scan_expr(item.context_expr, held, explicit)
+                    visit(node.body, held + tuple(acquired), explicit)
+                    continue
+                body_fields = [f for f in ("body", "orelse", "finalbody")
+                               if getattr(node, f, None)]
+                handlers = getattr(node, "handlers", [])
+                if body_fields or handlers:
+                    # Compound statement: scan header expressions, then
+                    # recurse into nested statement lists (a `with` inside
+                    # a loop must still open a scope).
+                    for field in ("test", "iter", "subject"):
+                        sub = getattr(node, field, None)
+                        if sub is not None:
+                            scan_expr(sub, held, explicit)
+                    for field in body_fields:
+                        visit(getattr(node, field), held, explicit)
+                    for h in handlers:
+                        visit(h.body, held, explicit)
+                else:
+                    scan_expr(node, held, explicit)
+
+        visit(getattr(fi.node, "body", []), (), [])
+
+
+# ---- graph construction entry point --------------------------------------
+
+
+def build_graph(pctx: ProjectContext) -> LockGraph:
+    cached = pctx.cache.get("lockgraph")
+    if cached is not None:
+        return cached  # type: ignore[return-value]
+    graph = LockGraph()
+    for ctx in pctx.contexts:
+        _scan_file(graph, pctx, ctx)
+    _pass_direct(graph, pctx)
+    _pass_scopes(graph, pctx)
+    pctx.cache["lockgraph"] = graph
+    return graph
+
+
+# ---- committed order -----------------------------------------------------
+
+
+def load_lock_order(path: str) -> list[dict] | None:
+    """The committed edge list, or None when the file does not exist
+    (fixture trees get no drift check, only cycle detection)."""
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        data = json.load(f)
+    return list(data.get("edges", []))
+
+
+def write_lock_order(path: str, edges: list[dict]) -> None:
+    from batchai_retinanet_horovod_coco_tpu.utils.atomicio import (
+        atomic_write_text,
+    )
+
+    uniq = sorted(
+        {(e["src"], e["dst"]) for e in edges}
+    )
+    atomic_write_text(path, json.dumps(
+        {"version": 1,
+         "edges": [{"src": s, "dst": d} for s, d in uniq]},
+        indent=1, sort_keys=True) + "\n")
+
+
+# ---- cycle detection -----------------------------------------------------
+
+
+def _cycles(edges: dict[Edge, list[Evidence]]) -> list[list[str]]:
+    """Every elementary cycle's node list (dedup by rotation), via DFS from
+    each node over the identity digraph.  Graphs here are tiny (tens of
+    nodes); Johnson's algorithm would be overkill."""
+    adj: dict[str, set[str]] = {}
+    for e in edges:
+        adj.setdefault(e.src, set()).add(e.dst)
+    seen: set[tuple[str, ...]] = set()
+    out: list[list[str]] = []
+
+    def dfs(start: str, node: str, path: list[str],
+            on_path: set[str]) -> None:
+        for nxt in sorted(adj.get(node, ())):
+            if nxt == start:
+                cyc = path[:]
+                # canonicalize rotation so A->B->A == B->A->B
+                i = cyc.index(min(cyc))
+                canon = tuple(cyc[i:] + cyc[:i])
+                if canon not in seen:
+                    seen.add(canon)
+                    out.append(list(canon))
+            elif nxt not in on_path and nxt > start:
+                # only expand nodes > start: each cycle found exactly once
+                # from its smallest node
+                path.append(nxt)
+                on_path.add(nxt)
+                dfs(start, nxt, path, on_path)
+                on_path.discard(nxt)
+                path.pop()
+
+    for n in sorted(adj):
+        dfs(n, n, [n], {n})
+    return out
+
+
+# ---- the rules -----------------------------------------------------------
+
+
+@register_project(
+    RULE_ORDER,
+    "cross-module lock acquisition order: cycles in the may-hold-while-"
+    "acquiring graph are potential deadlocks; the acyclic order is "
+    "committed in analysis/lock_order.json (non-growing)")
+def check_lock_order(pctx: ProjectContext) -> list[Finding]:
+    graph = build_graph(pctx)
+    pctx.count(RULE_ORDER, graph.sites)
+    findings: list[Finding] = []
+
+    edges_out = sorted({(e.src, e.dst) for e in graph.edges})
+    pctx.exports["lock_order_edges"] = [
+        {"src": s, "dst": d} for s, d in edges_out
+    ]
+    pctx.exports["lock_identities"] = sorted(graph.locks)
+
+    for cyc in _cycles(graph.edges):
+        chains = []
+        files: set[str] = set()
+        anchor: Evidence | None = None
+        for i, src in enumerate(cyc):
+            dst = cyc[(i + 1) % len(cyc)]
+            evs = graph.edges.get(Edge(src, dst), [])
+            ev = evs[0] if evs else None
+            if ev is not None:
+                files.add(ev.relpath)
+                if anchor is None:
+                    anchor = ev
+                via = f"; {ev.via}" if ev.via else ""
+                chains.append(f"{src} -> {dst} (held in {ev.holder}, "
+                              f"acquired {ev.relpath}:{ev.line}{via})")
+            else:
+                chains.append(f"{src} -> {dst}")
+        anchor = anchor or Evidence("", 0, "", "")
+        ctx = pctx.by_path.get(anchor.relpath)
+        findings.append(Finding(
+            rule=RULE_ORDER, path=anchor.relpath, line=anchor.line,
+            message="potential deadlock: lock acquisition cycle "
+                    + " | ".join(chains),
+            snippet=ctx.snippet(anchor.line) if ctx else "",
+            paths=tuple(sorted(files)),
+        ))
+
+    committed = load_lock_order(pctx.lock_order_path) \
+        if pctx.lock_order_path else None
+    if committed is not None:
+        want = {(e["src"], e["dst"]) for e in committed}
+        have = set(edges_out)
+        lock_rel = os.path.relpath(pctx.lock_order_path, pctx.root)
+        for s, d in sorted(have - want):
+            evs = graph.edges.get(Edge(s, d), [])
+            ev = evs[0] if evs else Evidence("", 0, "", "")
+            ctx = pctx.by_path.get(ev.relpath)
+            via = f"; {ev.via}" if ev.via else ""
+            findings.append(Finding(
+                rule=RULE_ORDER, path=ev.relpath, line=ev.line,
+                message=f"lock-order edge {s} -> {d} (held in {ev.holder}"
+                        f"{via}) is not in the committed "
+                        f"{lock_rel} — review and run --update-lock-order",
+                snippet=ctx.snippet(ev.line) if ctx else "",
+            ))
+        for s, d in sorted(want - have):
+            findings.append(Finding(
+                rule=RULE_ORDER, path=lock_rel, line=0,
+                message=f"stale committed lock-order edge {s} -> {d}: no "
+                        f"longer computed from the tree — run "
+                        f"--update-lock-order to shrink the order",
+                snippet=f"{s} -> {d}",
+            ))
+    return findings
+
+
+@register_project(
+    RULE_BLOCKING,
+    "blocking operations (no-timeout Queue get/put, join/wait/result, "
+    "time.sleep, sockets/HTTP, subprocess waits) while a lock is held")
+def check_lock_held_blocking(pctx: ProjectContext) -> list[Finding]:
+    graph = build_graph(pctx)
+    pctx.count(RULE_BLOCKING, graph.calls_inspected)
+    findings = []
+    for desc, ev, hold in graph.blocking:
+        ctx = pctx.by_path.get(ev.relpath)
+        via = f" {ev.via};" if ev.via else ""
+        findings.append(Finding(
+            rule=RULE_BLOCKING, path=ev.relpath, line=ev.line,
+            message=f"blocking {desc} in {ev.holder} while holding "
+                    f"{hold};{via} move the blocking call outside the "
+                    f"critical section or add a timeout",
+            snippet=ctx.snippet(ev.line) if ctx else "",
+        ))
+    return findings

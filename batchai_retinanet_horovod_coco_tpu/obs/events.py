@@ -50,6 +50,7 @@ from typing import Any, Mapping
 import numpy as np
 
 from batchai_retinanet_horovod_coco_tpu.obs import trace
+from batchai_retinanet_horovod_coco_tpu.utils.locks import make_lock
 
 
 def scalarize(metrics: Mapping[str, Any]) -> tuple[dict[str, float], list[str]]:
@@ -85,6 +86,40 @@ def latency_percentiles(
     out["mean_ms"] = round(float(arr.mean()), 3)
     out["max_ms"] = round(float(arr.max()), 3)
     return out
+
+
+#: Serializes the parseable JSONL emit stream process-wide.  One lock for
+#: EVERY emitter (fleet router, autoscaler, supervision CLI): the PR 16
+#: interleaving fix — concurrent emitters must not interleave partial
+#: lines, because downstream harnesses parse the stream as JSONL — now
+#: lives in exactly one place, and also holds ACROSS subsystems sharing a
+#: process (router + autoscaler), which per-object locks never did.
+_EMIT_LOCK = make_lock("obs.events._EMIT_LOCK")
+
+
+def emit_event(kind: str, *, sink=None, file=None, **fields) -> None:
+    """THE structured-event emit layering (ISSUE 15/16, consolidated here
+    by ISSUE 20): trace instant + guarded sink record + ONE serialized
+    JSONL line on ``file`` (default stderr) per event.
+
+    The output kwarg is named ``file`` (the ``print`` idiom) rather than
+    ``stream`` because stream IS an event field (``fleet_stream_reaped``
+    et al. carry the stream id) and ``**fields`` must be able to hold it.
+
+    The sink write is best-effort — a broken sink must not mask the
+    parseable line.  The line is built outside the lock and written with
+    a single ``write`` call under it."""
+    trace.instant(kind, **fields)
+    if sink is not None:
+        try:
+            sink.event(kind, **fields)
+        except Exception:
+            pass  # a broken sink must not mask the parseable line
+    out = file if file is not None else sys.stderr
+    line = json.dumps({"event": kind, **fields}) + "\n"
+    with _EMIT_LOCK:
+        out.write(line)
+        out.flush()
 
 
 def _git_rev() -> str | None:
@@ -162,7 +197,7 @@ class EventSink:
         # Serializes JSONL appends: the loop thread logs metrics while the
         # watchdog thread may write a stall event — interleaved partial
         # lines would corrupt both records.
-        self._write_lock = threading.Lock()
+        self._write_lock = make_lock("obs.events.EventSink._write_lock")
         self._tb = None
         self._t0 = trace.monotonic_s()
         self.run_id = uuid.uuid4().hex[:8]

@@ -55,6 +55,7 @@ from batchai_retinanet_horovod_coco_tpu.obs.events import (
     latency_percentiles,
 )
 from batchai_retinanet_horovod_coco_tpu.obs.trace import monotonic_s
+from batchai_retinanet_horovod_coco_tpu.utils.locks import make_lock
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _LABEL_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
@@ -142,7 +143,7 @@ class Counter(Metric):
 
     def __init__(self, name: str, help: str = ""):
         super().__init__(name, help)
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.telemetry.Counter._lock")
         self._values: dict[tuple[tuple[str, str], ...], float] = {}
 
     def inc(self, n: float = 1.0, **labels: str) -> None:
@@ -174,7 +175,7 @@ class Gauge(Metric):
         fn: Callable[[], float] | None = None,
     ):
         super().__init__(name, help)
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.telemetry.Gauge._lock")
         self._values: dict[tuple[tuple[str, str], ...], float] = {}
         self._fn = fn
 
@@ -215,7 +216,7 @@ class Histogram(Metric):
         source: Callable[[], Iterable[float]] | None = None,
     ):
         super().__init__(name, help)
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.telemetry.Histogram._lock")
         self._window = max(16, int(window))
         self._values: list[float] = []
         self._source = source
@@ -274,7 +275,7 @@ class Registry:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.telemetry.Registry._lock")
         self._metrics: dict[str, Metric] = {}
         self._collectors: list[Callable[[], Iterable[CollectorSample]]] = []
 
@@ -566,7 +567,7 @@ def _process_collector() -> Iterator[CollectorSample]:
 # steps-between-saves with no checkpoint landing.  (The wall-clock
 # age gauges stay informational: a multi-minute sync eval inflates
 # them while no step runs.)
-_ckpt_lock = threading.Lock()
+_ckpt_lock = make_lock("obs.telemetry._ckpt_lock")
 _ckpt_state = {
     "last_success_t": None,   # monotonic_s of the last landed save
     "interval_s": None,       # gap between the last two landed saves
@@ -696,7 +697,7 @@ def _current_train_step() -> float | None:
 # ---------------------------------------------------------------------------
 
 _default: Registry | None = None
-_default_lock = threading.Lock()
+_default_lock = make_lock("obs.telemetry._default_lock")
 
 
 def default() -> Registry:

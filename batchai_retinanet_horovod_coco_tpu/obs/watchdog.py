@@ -50,6 +50,7 @@ from typing import Any, Callable
 
 from batchai_retinanet_horovod_coco_tpu.obs import trace
 from batchai_retinanet_horovod_coco_tpu.obs.trace import monotonic_s
+from batchai_retinanet_horovod_coco_tpu.utils.locks import make_lock
 
 
 class _Component:
@@ -115,7 +116,7 @@ class Watchdog:
         self.dump_path = dump_path
         self.on_stall = on_stall
         self.sink = sink  # an obs.events.EventSink (or None)
-        self._lock = threading.Lock()
+        self._lock = make_lock("obs.watchdog.Watchdog._lock")
         self._components: dict[str, _Component] = {}
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()

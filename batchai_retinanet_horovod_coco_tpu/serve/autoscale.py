@@ -74,7 +74,9 @@ import threading
 from typing import Any, Callable
 
 from batchai_retinanet_horovod_coco_tpu.obs import trace, watchdog
+from batchai_retinanet_horovod_coco_tpu.obs.events import emit_event
 from batchai_retinanet_horovod_coco_tpu.obs.trace import monotonic_s
+from batchai_retinanet_horovod_coco_tpu.utils.locks import make_lock
 
 #: The federated-snapshot key whose per-poll increase signals demand at
 #: an EMPTY fleet (a request shed because no replica was routable).
@@ -167,8 +169,7 @@ class Autoscaler:
             router, "sink", None
         )
         self.decisions: list[dict] = []
-        self._lock = threading.Lock()
-        self._emit_lock = threading.Lock()
+        self._lock = make_lock("serve.autoscale.Autoscaler._lock")
         self._draining: dict[str, float] = {}  # rid -> drain start
         self._up_since: float | None = None
         self._down_since: float | None = None
@@ -438,17 +439,9 @@ class Autoscaler:
 
     def _emit_event(self, kind: str, **fields) -> None:
         """The fleet emit layering (ISSUE 15): trace instant + sink
-        record + ONE serialized stderr JSONL line per event."""
-        trace.instant(kind, **fields)
-        if self.sink is not None:
-            try:
-                self.sink.event(kind, **fields)
-            except Exception:
-                pass  # a broken sink must not mask the stderr line
-        line = json.dumps({"event": kind, **fields}) + "\n"
-        with self._emit_lock:
-            sys.stderr.write(line)
-            sys.stderr.flush()
+        record + ONE serialized stderr JSONL line per event — shared
+        implementation in obs.events.emit_event (ISSUE 20)."""
+        emit_event(kind, sink=self.sink, **fields)
 
     @staticmethod
     def _delta(prev: dict, snap: dict, key: str) -> float:

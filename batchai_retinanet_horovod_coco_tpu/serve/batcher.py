@@ -51,6 +51,7 @@ from batchai_retinanet_horovod_coco_tpu.data.pipeline import (
 )
 from batchai_retinanet_horovod_coco_tpu.obs import trace, watchdog
 from batchai_retinanet_horovod_coco_tpu.obs.trace import monotonic_s
+from batchai_retinanet_horovod_coco_tpu.utils.locks import make_lock
 from batchai_retinanet_horovod_coco_tpu.serve.common import (
     AssembledBatch,
     RequestTimeout,
@@ -73,7 +74,7 @@ class SlotPool:
     def __init__(self, capacity: int, now_fn: Callable[[], float] = monotonic_s):
         self.capacity = max(1, int(capacity))
         self._now = now_fn
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.batcher.SlotPool._lock")
         self._rows: list[ServeRequest] = []
         self._claim_t: list[float] = []
         self.first_claim_t: float | None = None

@@ -30,6 +30,7 @@ import numpy as np
 
 from batchai_retinanet_horovod_coco_tpu.obs.events import latency_percentiles
 from batchai_retinanet_horovod_coco_tpu.obs.trace import monotonic_s
+from batchai_retinanet_horovod_coco_tpu.utils.locks import make_lock
 
 
 class ServeError(RuntimeError):
@@ -241,7 +242,7 @@ class OccupancyStats:
     never the request hot path)."""
 
     def __init__(self, window: int = 1024):
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.common.OccupancyStats._lock")
         self._window = max(16, window)
         self._values: list[float] = []
         self._batches = 0
@@ -275,7 +276,7 @@ class LatencyStats:
     """
 
     def __init__(self, window: int = 4096):
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.common.LatencyStats._lock")
         self._window = max(16, window)
         self._latencies: list[float] = []
         self.completed = 0

@@ -46,6 +46,7 @@ import numpy as np
 from batchai_retinanet_horovod_coco_tpu.data.pipeline import stop_gated_put
 from batchai_retinanet_horovod_coco_tpu.obs import trace, watchdog
 from batchai_retinanet_horovod_coco_tpu.serve.common import AssembledBatch
+from batchai_retinanet_horovod_coco_tpu.utils.locks import make_lock
 
 
 class IdentityLabelMap(dict):
@@ -257,7 +258,7 @@ class DispatchGate:
 
     def __init__(self):
         self._event = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.engine.DispatchGate._lock")
         self._armed: set = set()
 
     def set_ready(self) -> None:

@@ -94,6 +94,7 @@ from batchai_retinanet_horovod_coco_tpu.obs.telemetry import (
     Registry,
     parse_exposition_samples,
 )
+from batchai_retinanet_horovod_coco_tpu.obs.events import emit_event
 from batchai_retinanet_horovod_coco_tpu.obs.trace import monotonic_s
 from batchai_retinanet_horovod_coco_tpu.serve.common import (
     LatencyStats,
@@ -105,6 +106,7 @@ from batchai_retinanet_horovod_coco_tpu.serve.replica import (
     ReplicaUnavailable,
 )
 from batchai_retinanet_horovod_coco_tpu.utils.backoff import BackoffPolicy
+from batchai_retinanet_horovod_coco_tpu.utils.locks import make_lock
 
 # Breaker states (also the fleet_breaker_state gauge encoding).
 CLOSED = "closed"
@@ -224,7 +226,7 @@ class _StreamPin:
     def __init__(self, sid, st, backend_sid, width, height, trace_id,
                  now: float):
         self.sid = sid
-        self.lock = threading.Lock()
+        self.lock = make_lock("serve.fleet._StreamPin.lock")
         self.st = st  # the pinned _ReplicaState
         self.backend_sid = backend_sid
         self.backend_seq = 0  # the PINNED replica's expected seq
@@ -278,8 +280,7 @@ class FleetRouter:
         self.sink = sink
         self.stats = LatencyStats(window=config.latency_window)
         self._states = [_ReplicaState(r) for r in replicas]
-        self._lock = threading.Lock()
-        self._emit_lock = threading.Lock()
+        self._lock = make_lock("serve.fleet.FleetRouter._lock")
         self._rng = random.Random(config.seed)
         self._accepting = True
         self._inflight = 0
@@ -1206,21 +1207,9 @@ class FleetRouter:
     # ---- observability ---------------------------------------------------
 
     def _emit_event(self, kind: str, **fields) -> None:
-        record = {"event": kind, **fields}
-        trace.instant(kind, **fields)
-        if self.sink is not None:
-            try:
-                self.sink.event(kind, **fields)
-            except Exception:
-                pass  # a broken sink must not mask the stderr line
-        # One write call per line, serialized: concurrent emitters (e.g.
-        # two streams re-pinning off the same dead replica) must not
-        # interleave partial lines — downstream harnesses parse this
-        # stream as JSONL.
-        line = json.dumps(record) + "\n"
-        with self._emit_lock:
-            sys.stderr.write(line)
-            sys.stderr.flush()
+        # Shared emit layering — trace instant + sink + ONE serialized
+        # stderr JSONL line — lives in obs.events.emit_event (ISSUE 20).
+        emit_event(kind, sink=self.sink, **fields)
 
     def _canary_baseline_p99(self) -> float | None:
         """Median p99 over CLOSED non-canary replicas (the fleet
@@ -1874,14 +1863,9 @@ def main(argv: list[str] | None = None) -> dict:
     def emit(kind: str, **fields) -> None:
         """Supervision events: stdout line (the chaos harness parses
         these) + trace instant + sink record (ISSUE 15 — replica
-        lifecycle is a fleet decision like any breaker transition)."""
-        print(json.dumps({"event": kind, **fields}), flush=True)
-        trace.instant(kind, **fields)
-        if sink is not None:
-            try:
-                sink.event(kind, **fields)
-            except Exception:
-                pass  # a broken sink must not mask the stdout line
+        lifecycle is a fleet decision like any breaker transition).
+        Shared layering from obs.events.emit_event (ISSUE 20)."""
+        emit_event(kind, sink=sink, file=sys.stdout, **fields)
 
     spawn_extra = shlex.split(args.spawn_serve_args or "")
     replicas: list = [HttpReplica(url) for url in args.replica]

@@ -81,6 +81,7 @@ from batchai_retinanet_horovod_coco_tpu.serve.engine import (
     DispatchGate,
 )
 from batchai_retinanet_horovod_coco_tpu.serve.router import Router
+from batchai_retinanet_horovod_coco_tpu.utils.locks import make_lock
 
 
 class DetectionServer:
@@ -136,7 +137,7 @@ class DetectionServer:
             engine.warmup()
 
         self._stop = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.frontend.DetectionServer._lock")
         self._drained = threading.Condition(self._lock)
         self._outstanding: dict[int, ServeRequest] = {}
         self._error: BaseException | None = None
@@ -600,7 +601,7 @@ def serve_http(
     # request: image-only servers never pay for it, and every existing
     # ``shutdown(); server_close()`` teardown stays leak-free because
     # ``server_close`` below also closes the manager if one was made.
-    _stream_lock = threading.Lock()
+    _stream_lock = make_lock("serve.frontend.serve_http._stream_lock")
     _stream_holder = [stream]
 
     def _stream():

@@ -72,6 +72,7 @@ from batchai_retinanet_horovod_coco_tpu.serve.common import (
     StreamConfig,
 )
 from batchai_retinanet_horovod_coco_tpu.serve.router import decode_payload
+from batchai_retinanet_horovod_coco_tpu.utils.locks import make_lock
 
 
 def _xywh_to_xyxy(boxes: np.ndarray) -> np.ndarray:
@@ -212,7 +213,7 @@ class _Session:
         self.sid = sid
         self.bucket = bucket
         self.trace_id = trace_id
-        self.lock = threading.Lock()
+        self.lock = make_lock("serve.stream._Session.lock")
         self.next_seq = 0
         self.inflight: collections.deque[_FrameEntry] = collections.deque()
         # Seqs consumed by submit_frame whose _admit has not yet appended
@@ -285,7 +286,7 @@ class StreamManager:
         self.server = server
         self.config = config or StreamConfig()
         self._now = now_fn
-        self._lock = threading.Lock()
+        self._lock = make_lock("serve.stream.StreamManager._lock")
         self._sessions: dict[str, _Session] = {}
         self._closed = False
         # Manager-wide counters (under self._lock).

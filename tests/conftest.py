@@ -12,6 +12,14 @@ enough).
 
 import os
 
+# ISSUE 20: arm the runtime lock-order witness for the whole tier — every
+# utils.locks.make_lock() site returns a debug wrapper that raises on any
+# inversion of the committed analysis/lock_order.json order, so tier-1
+# validates the static lock order on every run.  setdefault: an explicit
+# RETINANET_LOCK_DEBUG=0 still wins (bisection escape hatch).  Subprocess
+# legs (chaos, fleet smokes) inherit it through the environment.
+os.environ.setdefault("RETINANET_LOCK_DEBUG", "1")
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -1,0 +1,14 @@
+"""Lock-graph fixture modules (ISSUE 20).
+
+Each module is a minimal, self-contained concurrency shape the
+``lock-order`` / ``lock-held-blocking`` project rules must classify
+correctly.  Tests copy a selection of these files into a throwaway tree
+shaped like the real package (``<tmp>/<PACKAGE_NAME>/lockgraph/*.py``)
+and run the engine over it — they are never imported by the live tree and
+never scanned by the live lint run (``tests/`` is excluded).
+
+- ``cyclic.py``    — a known 3-lock cycle (the one deadlock the rule must find)
+- ``diamond.py``   — 4 locks, 5 edges, NO cycle (the false-positive guard)
+- ``indirect.py``  — an edge only visible through one-level call resolution
+- ``suppressed.py``— blocking-while-locked sites: one suppressed, two bites
+"""

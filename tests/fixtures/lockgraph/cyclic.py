@@ -1,0 +1,31 @@
+"""The known deadlock: three locks acquired in a 3-cycle.
+
+``ab`` holds A then takes B, ``bc`` holds B then takes C, ``ca`` holds C
+then takes A — the may-hold-while-acquiring graph is A->B->C->A and the
+``lock-order`` rule must report exactly ONE cycle naming all three
+identities and all three acquisition sites.
+"""
+
+import threading
+
+
+class Trio:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._c = threading.Lock()
+
+    def ab(self):
+        with self._a:
+            with self._b:
+                pass
+
+    def bc(self):
+        with self._b:
+            with self._c:
+                pass
+
+    def ca(self):
+        with self._c:
+            with self._a:
+                pass

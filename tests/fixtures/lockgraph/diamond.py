@@ -1,0 +1,36 @@
+"""The no-cycle control: a diamond acquisition order.
+
+top -> {left, right} -> bottom plus the transitive top -> bottom edge —
+five edges, zero cycles.  ``explicit_pair`` re-states top -> left through
+bare ``.acquire()``/``.release()`` calls so the explicit-hold tracking is
+exercised alongside ``with``.
+"""
+
+import threading
+
+
+class Diamond:
+    def __init__(self):
+        self._top = threading.Lock()
+        self._left = threading.Lock()
+        self._right = threading.Lock()
+        self._bottom = threading.Lock()
+
+    def via_left(self):
+        with self._top:
+            with self._left:
+                with self._bottom:
+                    pass
+
+    def via_right(self):
+        with self._top, self._right:
+            with self._bottom:
+                pass
+
+    def explicit_pair(self):
+        self._top.acquire()
+        try:
+            with self._left:
+                pass
+        finally:
+            self._top.release()

@@ -1,0 +1,29 @@
+"""The one-level-indirect edge: visible only through call resolution.
+
+``Outer.nudge`` holds ``Outer._lock`` and calls ``self._inner.poke()``;
+``Inner.poke`` takes ``Inner._lock``.  No single function acquires both
+locks, so the edge Outer._lock -> Inner._lock exists only if the rule
+resolves the attribute-typed call one level deep (``self._inner`` was
+constructed as ``Inner()`` in ``__init__``).
+"""
+
+import threading
+
+
+class Inner:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def poke(self):
+        with self._lock:
+            pass
+
+
+class Outer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._inner = Inner()
+
+    def nudge(self):
+        with self._lock:
+            self._inner.poke()

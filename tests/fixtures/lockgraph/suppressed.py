@@ -1,0 +1,29 @@
+"""Blocking-while-locked sites for the ``lock-held-blocking`` rule.
+
+``quarantine`` is the suppressed twin (rationale on the offending line);
+``bite`` is the direct finding; ``indirect_bite`` only blocks through a
+one-level callee, so its finding must carry the via-path to ``_nap``.
+"""
+
+import threading
+import time
+
+
+class Sleeper:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def quarantine(self):
+        with self._lock:
+            time.sleep(0.01)  # lint: lock-held-blocking: fixture twin — sanctioned nap
+
+    def bite(self):
+        with self._lock:
+            time.sleep(0.01)
+
+    def _nap(self):
+        time.sleep(0.01)
+
+    def indirect_bite(self):
+        with self._lock:
+            self._nap()

@@ -16,11 +16,16 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import subprocess
 import sys
 import textwrap
+import threading
+
+import pytest
 
 from batchai_retinanet_horovod_coco_tpu.analysis import engine
+from batchai_retinanet_horovod_coco_tpu.utils import locks
 
 REPO_ROOT = os.path.dirname(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -301,6 +306,89 @@ class TestJitPurity:
                 return x
 
             step_c = jax.jit(step)
+            """,
+            "jit-purity",
+        )
+        assert res.findings == [] and len(res.suppressed) == 1
+
+    def test_pure_callback_subtree_is_sanctioned(self):
+        """ISSUE 20: jax.pure_callback / io_callback are THE supported
+        host-escape hatches — host effects inside their callback argument
+        run outside the trace by contract and must not be flagged."""
+        ok = """
+        import jax
+        from jax.experimental import io_callback
+
+        def step(x):
+            y = jax.pure_callback(lambda v: print(v), x.dtype, x)
+            io_callback(lambda v: open("/tmp/l", "a").write(str(v)), None, y)
+            return y
+
+        step_c = jax.jit(step)
+        """
+        assert findings(ok, "jit-purity") == []
+
+    def test_host_effect_outside_callback_still_bites(self):
+        """The sanction covers ONLY the callback call's subtree."""
+        got = findings(
+            """
+            import jax
+
+            def step(x):
+                print("tracing")
+                y = jax.pure_callback(lambda v: print(v), x.dtype, x)
+                return y
+
+            step_c = jax.jit(step)
+            """,
+            "jit-purity",
+        )
+        assert len(got) == 1 and "print()" in got[0].message
+
+    def test_lru_cache_on_jitted_fn_bites(self):
+        got = findings(
+            """
+            import functools
+            import jax
+
+            @jax.jit
+            @functools.lru_cache(maxsize=None)
+            def step(x):
+                return x * 2
+            """,
+            "jit-purity",
+        )
+        assert len(got) == 1
+        assert "lru_cache" in got[0].message
+        assert "tracer" in got[0].message
+
+    def test_lru_cache_via_call_form_bites(self):
+        got = findings(
+            """
+            import functools
+            import jax
+
+            @functools.cache
+            def step(x):
+                return x * 2
+
+            step_c = jax.jit(step)
+            """,
+            "jit-purity",
+        )
+        assert len(got) == 1 and "functools.cache" in got[0].message
+
+    def test_lru_cache_suppressed_twin_passes(self):
+        res = run_rule(
+            """
+            import functools
+            import jax
+
+            @jax.jit
+            # lint: jit-purity: keyed on static python ints only
+            @functools.lru_cache(maxsize=8)
+            def step(x):
+                return x * 2
             """,
             "jit-purity",
         )
@@ -720,6 +808,14 @@ class TestLiveTree:
         # (anchor sidecar, trace export, perf report, numerics dump,
         # checkpoint writer).
         assert stats.get("atomic-artifacts", 0) >= 5, stats
+        # ISSUE 20 project rules: acceptance floors — the lock graph must
+        # resolve real acquisition sites and the vocabulary checker must
+        # see real emit sites (live counts: ~133 / ~69 / ~205).
+        assert stats.get("lock-order", 0) >= 20, stats
+        assert stats.get("event-vocabulary", 0) >= 40, stats
+        assert stats.get("lock-held-blocking", 0) >= 50, stats
+        assert len(report["exports"]["lock_identities"]) >= 15, (
+            report["exports"]["lock_identities"])
 
     def test_compliance_is_load_bearing(self):
         """Removing one package-side compliance makes the engine fail:
@@ -747,7 +843,10 @@ class TestLiveTree:
         )
         assert proc.returncode == 0, proc.stdout[-2000:] + proc.stderr[-2000:]
         report = json.loads(proc.stdout.strip().splitlines()[-1])
-        assert report["ok"] and set(report["rules"]) == set(engine.RULES)
+        assert report["ok"]
+        assert set(report["rules"]) == set(engine.all_rule_names())
+        assert set(report["rules"]) >= {"lock-order", "lock-held-blocking",
+                                        "event-vocabulary"}
 
     def test_cli_unknown_rule_is_a_clean_error(self):
         """A typo'd --rule must exit 2 with the known-rule list, not die
@@ -885,3 +984,334 @@ class TestAuditCollectivesDedupe:
         hlo = "  %ar = (f32[10]{0}, f32[20]{0}) all-reduce(%a, %b)\n"
         r = ac.audit_hlo_text(hlo)
         assert r["all-reduce"] == {"count": 1, "payload_bytes": 120}, r
+
+
+# ---- lock-order / lock-held-blocking fixtures (ISSUE 20) -----------------
+
+
+FIXTURE_DIR = os.path.join(REPO_ROOT, "tests", "fixtures", "lockgraph")
+
+_CYC = "lockgraph.cyclic.Trio."
+_DIA = "lockgraph.diamond.Diamond."
+_OUTER = "lockgraph.indirect.Outer._lock"
+_INNER = "lockgraph.indirect.Inner._lock"
+
+
+def _lock_tree(tmp_path, modules):
+    """A throwaway tree shaped like the real package, populated with the
+    selected ``tests/fixtures/lockgraph`` modules; returns (root, empty
+    baseline path)."""
+    sub = tmp_path / engine.PACKAGE_NAME / "lockgraph"
+    sub.mkdir(parents=True)
+    (tmp_path / engine.PACKAGE_NAME / "__init__.py").write_text("")
+    (sub / "__init__.py").write_text("")
+    for m in modules:
+        shutil.copy(os.path.join(FIXTURE_DIR, m + ".py"),
+                    str(sub / (m + ".py")))
+    bl = tmp_path / "baseline.json"
+    engine.write_baseline(str(bl), [])
+    return str(tmp_path), str(bl)
+
+
+class TestLockOrder:
+    def test_finds_exactly_the_cycle(self, tmp_path):
+        """The whole fixture set contains exactly ONE deadlock (cyclic.py's
+        A->B->C->A); the diamond and the indirect edge must not add false
+        cycles, and the finding must name all three acquisition chains."""
+        root, bl = _lock_tree(
+            tmp_path, ["cyclic", "diamond", "indirect", "suppressed"])
+        report = engine.run(root, baseline_path=bl,
+                            rule_names=["lock-order"])
+        assert len(report["new"]) == 1, report["new"]
+        f = report["new"][0]
+        assert "potential deadlock" in f["message"]
+        for ident in (_CYC + "_a", _CYC + "_b", _CYC + "_c"):
+            assert ident in f["message"], f["message"]
+        cyc_rel = os.path.join(engine.PACKAGE_NAME, "lockgraph", "cyclic.py")
+        assert list(f["paths"]) == [cyc_rel]
+        assert not report["ok"]
+
+    def test_diamond_is_acyclic_and_edges_exported(self, tmp_path):
+        root, bl = _lock_tree(tmp_path, ["diamond", "indirect"])
+        report = engine.run(root, baseline_path=bl,
+                            rule_names=["lock-order"])
+        assert report["new"] == [] and report["ok"], report["new"]
+        edges = {(e["src"], e["dst"])
+                 for e in report["exports"]["lock_order_edges"]}
+        for src, dst in (("_top", "_left"), ("_top", "_right"),
+                         ("_top", "_bottom"), ("_left", "_bottom"),
+                         ("_right", "_bottom")):
+            assert (_DIA + src, _DIA + dst) in edges, edges
+        assert (_OUTER, _INNER) in edges, edges  # one-level resolution
+
+    def test_new_edge_vs_committed_order_fails_with_via(self, tmp_path):
+        """Drift discipline: an edge the committed file lacks fails the
+        run, and the one-level-indirect edge's finding names the callee
+        acquisition it was resolved through."""
+        root, bl = _lock_tree(tmp_path, ["diamond", "indirect"])
+        r0 = engine.run(root, baseline_path=bl, rule_names=["lock-order"])
+        committed = [e for e in r0["exports"]["lock_order_edges"]
+                     if e["src"] != _OUTER]
+        from batchai_retinanet_horovod_coco_tpu.analysis.rules import (
+            lock_graph,
+        )
+        order = tmp_path / "order.json"
+        lock_graph.write_lock_order(str(order), committed)
+        report = engine.run(root, baseline_path=bl,
+                            rule_names=["lock-order"],
+                            lock_order_path=str(order))
+        assert not report["ok"] and len(report["new"]) == 1, report["new"]
+        msg = report["new"][0]["message"]
+        assert "not in the committed" in msg
+        assert "call lockgraph.indirect.Inner.poke()" in msg, msg
+
+    def test_stale_committed_edge_fails(self, tmp_path):
+        root, bl = _lock_tree(tmp_path, ["diamond", "indirect"])
+        r0 = engine.run(root, baseline_path=bl, rule_names=["lock-order"])
+        from batchai_retinanet_horovod_coco_tpu.analysis.rules import (
+            lock_graph,
+        )
+        order = tmp_path / "order.json"
+        lock_graph.write_lock_order(
+            str(order),
+            r0["exports"]["lock_order_edges"]
+            + [{"src": "lockgraph.ghost.A", "dst": "lockgraph.ghost.B"}])
+        report = engine.run(root, baseline_path=bl,
+                            rule_names=["lock-order"],
+                            lock_order_path=str(order))
+        assert not report["ok"] and len(report["new"]) == 1, report["new"]
+        assert "stale committed lock-order edge" in report["new"][0]["message"]
+
+    def test_committed_order_matching_is_clean(self, tmp_path):
+        root, bl = _lock_tree(tmp_path, ["diamond", "indirect"])
+        r0 = engine.run(root, baseline_path=bl, rule_names=["lock-order"])
+        from batchai_retinanet_horovod_coco_tpu.analysis.rules import (
+            lock_graph,
+        )
+        order = tmp_path / "order.json"
+        lock_graph.write_lock_order(
+            str(order), r0["exports"]["lock_order_edges"])
+        report = engine.run(root, baseline_path=bl,
+                            rule_names=["lock-order"],
+                            lock_order_path=str(order))
+        assert report["ok"] and report["new"] == [], report["new"]
+
+    def test_cycle_fingerprint_is_cross_file_and_line_insensitive(
+            self, tmp_path):
+        """A cycle finding baselines on (rule, sorted-path-set, snippet):
+        the grandfathered entry matches regardless of its recorded line."""
+        root, bl = _lock_tree(tmp_path, ["cyclic"])
+        r0 = engine.run(root, baseline_path=bl, rule_names=["lock-order"])
+        d = r0["new"][0]
+        bl2 = tmp_path / "baseline2.json"
+        engine.write_baseline(str(bl2), [engine.Finding(
+            rule=d["rule"], path=d["path"], line=999, message="",
+            snippet=d["snippet"], paths=d["paths"],
+        )])
+        r1 = engine.run(root, baseline_path=str(bl2),
+                        rule_names=["lock-order"])
+        assert r1["ok"], r1["new"]
+        assert len(r1["grandfathered"]) == 1 and r1["new"] == []
+
+
+class TestLockHeldBlocking:
+    def test_bites_direct_and_via_callee_and_suppressed_twin(self, tmp_path):
+        root, bl = _lock_tree(tmp_path, ["suppressed"])
+        report = engine.run(root, baseline_path=bl,
+                            rule_names=["lock-held-blocking"])
+        assert len(report["new"]) == 2, report["new"]
+        msgs = [f["message"] for f in report["new"]]
+        assert all("time.sleep" in m for m in msgs)
+        assert all("lockgraph.suppressed.Sleeper._lock (acquired" in m
+                   for m in msgs), msgs  # full hold-site path named
+        assert any("via lockgraph.suppressed.Sleeper._nap()" in m
+                   for m in msgs), msgs  # one-level blocking path
+        assert len(report["suppressed"]) == 1, report["suppressed"]
+
+
+class TestEngineParallelAndCache:
+    def test_jobs_report_identical(self, tmp_path):
+        root, bl = _lock_tree(
+            tmp_path, ["cyclic", "diamond", "indirect", "suppressed"])
+        serial = engine.run(root, baseline_path=bl, jobs=1)
+        par = engine.run(root, baseline_path=bl, jobs=4)
+        assert serial == par
+
+    def test_parse_cache_invalidated_on_edit(self, tmp_path):
+        """Warm-cache runs must still see edits: rewriting the innermost
+        diamond acquisition to re-take ``_top`` creates a left<->top cycle
+        that the second (cache-warm) run must report."""
+        root, bl = _lock_tree(tmp_path, ["diamond"])
+        r0 = engine.run(root, baseline_path=bl, rule_names=["lock-order"])
+        assert r0["ok"]
+        mod = tmp_path / engine.PACKAGE_NAME / "lockgraph" / "diamond.py"
+        mod.write_text(mod.read_text().replace(
+            "with self._bottom:", "with self._top:"))
+        r1 = engine.run(root, baseline_path=bl, rule_names=["lock-order"])
+        assert any("potential deadlock" in f["message"]
+                   for f in r1["new"]), r1["new"]
+
+    def test_cli_refuses_update_lock_order_with_rule_filter(self):
+        proc = subprocess.run(
+            [sys.executable, "-m",
+             "batchai_retinanet_horovod_coco_tpu.analysis",
+             "--rule", "lock-order", "--update-lock-order"],
+            capture_output=True, text=True, cwd=REPO_ROOT, timeout=120,
+        )
+        assert proc.returncode == 2, proc.stdout + proc.stderr
+        assert "full run" in proc.stderr
+
+
+# ---- event-vocabulary ----------------------------------------------------
+
+
+class TestEventVocabulary:
+    def _tree(self, tmp_path, suppress_rogue: bool = False):
+        pkg = tmp_path / engine.PACKAGE_NAME
+        obs = pkg / "obs"
+        obs.mkdir(parents=True)
+        (pkg / "__init__.py").write_text("")
+        (obs / "__init__.py").write_text("")
+        reader_rel = f"{engine.PACKAGE_NAME}/reader.py"
+        (obs / "vocabulary.py").write_text(textwrap.dedent(f"""
+            VOCABULARY = {{
+                "good_event": {{"kinds": ("event",),
+                                "consumers": ("{reader_rel}",)}},
+                "ghost_event": {{"kinds": ("event",),
+                                 "consumers": ("{reader_rel}",)}},
+                "stale_event": {{"kinds": ("series",), "consumers": ()}},
+                "lost_event": {{"kinds": ("event",),
+                                "consumers": ("no/such/file.py",)}},
+            }}
+        """))
+        sup = ("  # lint: event-vocabulary: ad-hoc debug counter\n"
+               if suppress_rogue else "")
+        (pkg / "emitter.py").write_text(
+            "def go(sink, reg):\n"
+            '    sink.event("good_event", n=1)\n'
+            '    sink.event("lost_event")\n'
+            f"{sup}"
+            '    reg.counter("rogue_series")\n'
+        )
+        (pkg / "reader.py").write_text(
+            "def read(ev):\n"
+            '    return ev["event"] in ("good_event", "ghost_event")\n'
+        )
+        bl = tmp_path / "baseline.json"
+        engine.write_baseline(str(bl), [])
+        return str(tmp_path), str(bl)
+
+    def test_flags_unregistered_orphan_and_stale(self, tmp_path):
+        root, bl = self._tree(tmp_path)
+        report = engine.run(root, baseline_path=bl,
+                            rule_names=["event-vocabulary"])
+        msgs = sorted(f["message"] for f in report["new"])
+        assert len(msgs) == 4, msgs
+        assert any("emitted-but-unregistered" in m and "rogue_series" in m
+                   for m in msgs), msgs
+        assert any("consumed-but-never-emitted" in m and "ghost_event" in m
+                   and "reader.py" in m for m in msgs), msgs
+        assert any("registered-but-never-emitted" in m and "stale_event" in m
+                   for m in msgs), msgs
+        assert any("not a scanned file" in m and "no/such/file.py" in m
+                   for m in msgs), msgs
+        assert report["stats"]["event-vocabulary"] >= 3
+        assert "good_event" in report["exports"]["event_names_emitted"]
+
+    def test_suppressed_emit_site_passes(self, tmp_path):
+        root, bl = self._tree(tmp_path, suppress_rogue=True)
+        report = engine.run(root, baseline_path=bl,
+                            rule_names=["event-vocabulary"])
+        assert not any("rogue_series" in f["message"]
+                       for f in report["new"]), report["new"]
+        assert any("rogue_series" in f["message"]
+                   for f in report["suppressed"])
+
+    def test_fixture_trees_without_vocabulary_are_exempt(self, tmp_path):
+        root, bl = _lock_tree(tmp_path, ["diamond"])
+        report = engine.run(root, baseline_path=bl,
+                            rule_names=["event-vocabulary"])
+        assert report["new"] == [] and report["ok"]
+
+
+# ---- runtime lock-order witness (utils/locks.py) -------------------------
+
+
+def test_witness_armed_in_tier1():
+    """tests/conftest.py arms RETINANET_LOCK_DEBUG for the whole tier, so
+    every multithreaded test validates the committed order for free."""
+    assert os.environ.get(locks.ENV_FLAG) == "1"
+    assert locks.enabled()
+
+
+class TestLockWitness:
+    @pytest.fixture(autouse=True)
+    def _armed(self, monkeypatch):
+        monkeypatch.setenv(locks.ENV_FLAG, "1")
+        locks._set_committed_for_testing(set())
+        locks.reset_observed()
+        yield
+        locks._set_committed_for_testing(None)
+        locks.reset_observed()
+
+    def test_disabled_is_identity(self, monkeypatch):
+        """PARITY: with the flag off, make_lock returns a PLAIN lock."""
+        monkeypatch.setenv(locks.ENV_FLAG, "0")
+        assert type(locks.make_lock("x")) is type(threading.Lock())
+        assert type(locks.make_rlock("x")) is type(threading.RLock())
+
+    def test_committed_order_passes_and_inversion_raises(self):
+        locks._set_committed_for_testing({("fix.A", "fix.B")})
+        a, b = locks.make_lock("fix.A"), locks.make_lock("fix.B")
+        with a:
+            with b:
+                pass  # the committed direction: clean
+        with b:
+            with pytest.raises(locks.LockOrderViolation) as ei:
+                with a:
+                    pass
+        msg = str(ei.value)
+        # Both chains named: this thread's actual chain and the committed.
+        assert "[fix.B -> fix.A]" in msg, msg
+        assert "'fix.A' -> 'fix.B'" in msg, msg
+
+    def test_unknown_pairs_recorded_not_raised(self):
+        a, b = locks.make_lock("w.A"), locks.make_lock("w.B")
+        with a:
+            with b:
+                pass
+        assert ("w.A", "w.B") in locks.observed_edges()
+
+    def test_reentry_never_checked(self):
+        locks._set_committed_for_testing({("r.A", "r.B")})
+        r = locks.make_rlock("r.B")
+        with r:
+            with r:  # same-name reentry: exempt by design
+                pass
+
+    def test_condition_over_debug_rlock(self):
+        cv = threading.Condition(locks.make_rlock("cv.lock"))
+        with cv:
+            cv.notify_all()
+
+    def test_static_edges_drive_the_witness(self, tmp_path):
+        """End-to-end over the fixture package: the edges the STATIC rule
+        computes become the committed order the RUNTIME witness enforces —
+        replaying the diamond's sanctioned order passes, the inverted
+        acquisition raises."""
+        root, bl = _lock_tree(tmp_path, ["diamond"])
+        report = engine.run(root, baseline_path=bl,
+                            rule_names=["lock-order"])
+        edges = {(e["src"], e["dst"])
+                 for e in report["exports"]["lock_order_edges"]}
+        assert (_DIA + "_top", _DIA + "_bottom") in edges
+        locks._set_committed_for_testing(edges)
+        top = locks.make_lock(_DIA + "_top")
+        bottom = locks.make_lock(_DIA + "_bottom")
+        with top:
+            with bottom:
+                pass
+        with bottom:
+            with pytest.raises(locks.LockOrderViolation):
+                with top:
+                    pass

@@ -379,6 +379,77 @@ class TestEventSink:
         assert events["gauge"]["name"] == "qsize"
         assert events["gauge"]["value"] == 3.0
 
+    def test_emit_event_concurrent_lines_never_interleave(self, tmp_path):
+        """ISSUE 20 consolidation: every subsystem's structured emit goes
+        through ONE serialized ``emit_event`` — 8 concurrent emitters into
+        one stream must yield only whole, parseable JSONL lines (the PR 16
+        interleaving class, now guarded in exactly one place)."""
+        import io
+
+        stream = io.StringIO()
+        sink = EventSink(str(tmp_path), stdout=False)
+        n_threads, n_each = 8, 50
+        errors: list[BaseException] = []
+
+        def emit(tid: int) -> None:
+            try:
+                for i in range(n_each):
+                    events_lib.emit_event(
+                        "serve_stats", sink=sink, file=stream,
+                        tid=tid, i=i, pad="x" * 64,
+                    )
+            except BaseException as e:  # pragma: no cover
+                errors.append(e)
+
+        threads = [threading.Thread(target=emit, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        sink.close()
+        assert errors == []
+        lines = stream.getvalue().splitlines()
+        assert len(lines) == n_threads * n_each
+        seen = set()
+        for line in lines:
+            rec = json.loads(line)  # raises on any torn/interleaved line
+            assert rec["event"] == "serve_stats"
+            seen.add((rec["tid"], rec["i"]))
+        assert len(seen) == n_threads * n_each  # nothing lost or doubled
+        # The guarded sink got every record too.
+        runs = split_runs(str(tmp_path / "metrics.jsonl"))
+        recs = [r for r in runs[0]["records"]
+                if r.get("event") == "serve_stats"]
+        assert len(recs) == n_threads * n_each
+
+    def test_emit_event_survives_broken_sink(self, tmp_path):
+        """The parseable line is the contract; a broken sink must not
+        mask it."""
+        import io
+
+        class Broken:
+            def event(self, *a, **k):
+                raise RuntimeError("sink down")
+
+        stream = io.StringIO()
+        events_lib.emit_event("serve_stats", sink=Broken(), file=stream,
+                              n=1)
+        rec = json.loads(stream.getvalue())
+        assert rec == {"event": "serve_stats", "n": 1}
+
+    def test_emit_event_stream_is_an_event_field_not_the_output(self):
+        """``stream`` is a live event field (``fleet_stream_reaped`` carries
+        the stream id) — it must land IN the JSON line, never be captured
+        as the output file (the tier-1 regression: ``'str' object has no
+        attribute 'write'``)."""
+        import io
+
+        out = io.StringIO()
+        events_lib.emit_event("fleet_stream_reaped", file=out, stream="s-1")
+        rec = json.loads(out.getvalue())
+        assert rec == {"event": "fleet_stream_reaped", "stream": "s-1"}
+
 
 class TestIntegration:
     def test_prefetch_map_traces_and_heartbeats(self, tmp_path):

@@ -493,7 +493,11 @@ class TestFleetAffinity:
             assert all(v == 0 for v in others)
             router.stream_close(sid)
         finally:
-            router.close()
+            # close_replicas reaches the LocalReplicas' lazily-attached
+            # StreamManagers — closing the bare servers does not, and the
+            # delivery threads outlive the test (caught by TestDrain's
+            # thread-enumeration assert when file order shuffles).
+            router.close(close_replicas=True)
             for s in servers:
                 s.close()
 
@@ -524,7 +528,11 @@ class TestFleetAffinity:
             assert repins[0][1]["to_replica"] != opened["replica_id"]
             assert router.status()["stream_repins"] == 1
         finally:
-            router.close()
+            # close_replicas reaches the LocalReplicas' lazily-attached
+            # StreamManagers — closing the bare servers does not, and the
+            # delivery threads outlive the test (caught by TestDrain's
+            # thread-enumeration assert when file order shuffles).
+            router.close(close_replicas=True)
             for s in servers:
                 s.close()
 
@@ -712,7 +720,11 @@ class TestFleetSeqLockstep:
                 dets, _hit = router.stream_frame(sid, seq, _frame(50))
                 assert dets
         finally:
-            router.close()
+            # close_replicas reaches the LocalReplicas' lazily-attached
+            # StreamManagers — closing the bare servers does not, and the
+            # delivery threads outlive the test (caught by TestDrain's
+            # thread-enumeration assert when file order shuffles).
+            router.close(close_replicas=True)
             for s in servers:
                 s.close()
 
@@ -734,7 +746,11 @@ class TestFleetSeqLockstep:
                 dets, _hit = router.stream_frame(sid, seq, _frame(60))
                 assert dets
         finally:
-            router.close()
+            # close_replicas reaches the LocalReplicas' lazily-attached
+            # StreamManagers — closing the bare servers does not, and the
+            # delivery threads outlive the test (caught by TestDrain's
+            # thread-enumeration assert when file order shuffles).
+            router.close(close_replicas=True)
             for s in servers:
                 s.close()
 
